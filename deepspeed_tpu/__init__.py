@@ -1,0 +1,94 @@
+"""deepspeed_tpu — TPU-native training framework with the DeepSpeed v0.3.11 API.
+
+Public surface parity with reference deepspeed/__init__.py: ``initialize()``,
+``add_config_arguments()``, ``init_distributed()``, engine/module exports.
+Compute path is JAX/XLA/Pallas over a named-axis device mesh.
+"""
+from deepspeed_tpu.version import __reference_version__, __version__
+
+# Heavier modules (engine, models) are imported lazily below so that pure-logic
+# users (config math, schedules, launcher CLI) don't pay the jax import cost.
+
+__git_hash__ = None
+__git_branch__ = None
+
+
+def initialize(args=None, model=None, optimizer=None, model_parameters=None,
+               training_data=None, lr_scheduler=None, mpu=None,
+               dist_init_required=None, collate_fn=None, config_params=None):
+    """Initialize the engine.  Mirrors reference deepspeed/__init__.py:50-139.
+
+    Returns a tuple of (engine, optimizer, training_dataloader, lr_scheduler).
+    """
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
+
+    if isinstance(model, PipelineModule):
+        engine = PipelineEngine(args=args, model=model, optimizer=optimizer,
+                                model_parameters=model_parameters,
+                                training_data=training_data,
+                                lr_scheduler=lr_scheduler, mpu=model.mpu() if mpu is None else mpu,
+                                dist_init_required=dist_init_required,
+                                collate_fn=collate_fn, config_params=config_params)
+    else:
+        engine = DeepSpeedEngine(args=args, model=model, optimizer=optimizer,
+                                 model_parameters=model_parameters,
+                                 training_data=training_data,
+                                 lr_scheduler=lr_scheduler, mpu=mpu,
+                                 dist_init_required=dist_init_required,
+                                 collate_fn=collate_fn, config_params=config_params)
+
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def add_config_arguments(parser):
+    """Add --deepspeed / --deepspeed_config argparse flags
+    (reference deepspeed/__init__.py:142-190)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag for user code; "
+                            "DeepSpeed=True if flag is present)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="DeepSpeed json configuration file.")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated enable DeepSpeed (helper flag)")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated DeepSpeed json configuration file.")
+    group.add_argument("--deepspeed_mpi", default=False, action="store_true",
+                       help="Run via MPI; discover rank/world from the MPI environment.")
+    return parser
+
+
+def init_distributed(dist_backend=None, auto_mpi_discovery=True,
+                     distributed_port=29500, verbose=True):
+    from deepspeed_tpu.utils.distributed import init_distributed as _init
+
+    return _init(dist_backend=dist_backend, auto_mpi_discovery=auto_mpi_discovery,
+                 distributed_port=distributed_port, verbose=verbose)
+
+
+def __getattr__(name):
+    # Lazy exports that pull in jax/flax.
+    if name == "DeepSpeedEngine":
+        from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+        return DeepSpeedEngine
+    if name == "PipelineEngine":
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+        return PipelineEngine
+    if name == "PipelineModule":
+        from deepspeed_tpu.runtime.pipe.module import PipelineModule
+        return PipelineModule
+    if name == "DeepSpeedConfig":
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        return DeepSpeedConfig
+    if name == "DeepSpeedTransformerLayer":
+        from deepspeed_tpu.ops.transformer import DeepSpeedTransformerLayer
+        return DeepSpeedTransformerLayer
+    if name == "DeepSpeedTransformerConfig":
+        from deepspeed_tpu.ops.transformer import DeepSpeedTransformerConfig
+        return DeepSpeedTransformerConfig
+    if name == "checkpointing":
+        from deepspeed_tpu.runtime import activation_checkpointing
+        return activation_checkpointing
+    raise AttributeError(f"module 'deepspeed_tpu' has no attribute {name!r}")

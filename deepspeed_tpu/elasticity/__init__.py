@@ -1,0 +1,8 @@
+from deepspeed_tpu.elasticity.config import (ElasticityConfig, ElasticityConfigError,
+                                             ElasticityError,
+                                             ElasticityIncompatibleWorldSize)
+from deepspeed_tpu.elasticity.elasticity import (compute_elastic_config,
+                                                 elasticity_enabled,
+                                                 ensure_immutable_elastic_config,
+                                                 get_candidate_batch_sizes,
+                                                 get_valid_gpus)

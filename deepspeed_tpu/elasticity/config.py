@@ -1,0 +1,58 @@
+"""Elasticity config object + errors (reference: deepspeed/elasticity/config.py)."""
+import json
+
+from deepspeed_tpu.elasticity.constants import (
+    ENABLED, ENABLED_DEFAULT, IGNORE_NON_ELASTIC_BATCH_INFO,
+    IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT, MAX_ACCEPTABLE_BATCH_SIZE,
+    MAX_ACCEPTABLE_BATCH_SIZE_DEFAULT, MAX_GPUS, MAX_GPUS_DEFAULT,
+    MICRO_BATCHES, MICRO_BATCHES_DEFAULT, MIN_GPUS, MIN_GPUS_DEFAULT,
+    MIN_TIME, MIN_TIME_DEFAULT, PREFER_LARGER_BATCH,
+    PREFER_LARGER_BATCH_DEFAULT, VERSION, VERSION_DEFAULT)
+
+
+class ElasticityError(Exception):
+    """Base elasticity error."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Invalid elasticity config."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """Current world size not in the valid elastic world-size set."""
+
+
+class ElasticityConfig:
+    def __init__(self, param_dict):
+        self.enabled = param_dict.get(ENABLED, ENABLED_DEFAULT)
+        if self.enabled:
+            if MAX_ACCEPTABLE_BATCH_SIZE not in param_dict:
+                raise ElasticityConfigError(f"Elasticity config missing {MAX_ACCEPTABLE_BATCH_SIZE}")
+            if MICRO_BATCHES not in param_dict:
+                raise ElasticityConfigError(f"Elasticity config missing {MICRO_BATCHES}")
+        self.max_acceptable_batch_size = param_dict.get(
+            MAX_ACCEPTABLE_BATCH_SIZE, MAX_ACCEPTABLE_BATCH_SIZE_DEFAULT)
+        self.micro_batches = param_dict.get(MICRO_BATCHES, MICRO_BATCHES_DEFAULT)
+        if not isinstance(self.micro_batches, list):
+            raise ElasticityConfigError(
+                f"{MICRO_BATCHES} must be a list of ints, got {self.micro_batches}")
+        if not all(isinstance(m, int) and m > 0 for m in self.micro_batches):
+            raise ElasticityConfigError(
+                f"{MICRO_BATCHES} values must be positive ints, got {self.micro_batches}")
+        self.min_gpus = param_dict.get(MIN_GPUS, MIN_GPUS_DEFAULT)
+        self.max_gpus = param_dict.get(MAX_GPUS, MAX_GPUS_DEFAULT)
+        if self.min_gpus < 1 or self.max_gpus < 1 or self.max_gpus < self.min_gpus:
+            raise ElasticityConfigError(
+                f"Invalid gpu range: min_gpus={self.min_gpus} max_gpus={self.max_gpus}")
+        self.min_time = param_dict.get(MIN_TIME, MIN_TIME_DEFAULT)
+        self.version = param_dict.get(VERSION, VERSION_DEFAULT)
+        self.prefer_larger_batch_size = param_dict.get(
+            PREFER_LARGER_BATCH, PREFER_LARGER_BATCH_DEFAULT)
+        self.ignore_non_elastic_batch_info = param_dict.get(
+            IGNORE_NON_ELASTIC_BATCH_INFO, IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+
+    def repr(self):
+        return self.__dict__.copy()
+
+    def __repr__(self):
+        return json.dumps(self.__dict__, indent=2)

@@ -1,0 +1,47 @@
+"""Elasticity config keys (reference: deepspeed/elasticity/constants.py).
+
+Format:
+  "elasticity": {
+    "enabled": false,
+    "max_train_batch_size": 2000,
+    "micro_batch_sizes": [2, 4, 6],
+    "min_gpus": 1,
+    "max_gpus": 10000,
+    "min_time": 0,
+    "version": 0.1,
+    "ignore_non_elastic_batch_info": false,
+    "prefer_larger_batch": true
+  }
+"""
+
+ELASTICITY = "elasticity"
+
+ENABLED = "enabled"
+ENABLED_DEFAULT = False
+
+MAX_ACCEPTABLE_BATCH_SIZE = "max_train_batch_size"
+MAX_ACCEPTABLE_BATCH_SIZE_DEFAULT = 2000
+
+MICRO_BATCHES = "micro_batch_sizes"
+MICRO_BATCHES_DEFAULT = [2, 4, 6]
+
+MIN_GPUS = "min_gpus"
+MIN_GPUS_DEFAULT = 1
+
+MAX_GPUS = "max_gpus"
+MAX_GPUS_DEFAULT = 10000
+
+MIN_TIME = "min_time"
+MIN_TIME_DEFAULT = 0
+
+IGNORE_NON_ELASTIC_BATCH_INFO = "ignore_non_elastic_batch_info"
+IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT = False
+
+PREFER_LARGER_BATCH = "prefer_larger_batch"
+PREFER_LARGER_BATCH_DEFAULT = True
+
+VERSION = "version"
+VERSION_DEFAULT = 0.1
+
+LATEST_ELASTICITY_VERSION = 0.1
+MINIMUM_DEEPSPEED_VERSION = "0.3.8"

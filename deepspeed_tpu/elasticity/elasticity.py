@@ -1,0 +1,247 @@
+"""Elastic batch-size / chip-count co-design (reference: deepspeed/elasticity/elasticity.py).
+
+Pure arithmetic, identical semantics to the reference: given a list of candidate
+micro-batch sizes and a max acceptable global batch size, find the global batch
+size that is compatible with the largest number of accelerator counts.  A world
+size W is compatible with batch B if there is a micro-batch m in the list with
+B % (m * W) == 0 (so gradient_accumulation_steps = B / (m*W) is a whole number).
+
+"Elastic" here is static co-design (not runtime failover): resizing happens by
+restart + elastic ZeRO checkpoint repartitioning, same as the reference
+(elasticity.py:122-172, compute_elastic_config :240).
+"""
+import hashlib
+import json
+from functools import reduce
+from math import gcd
+
+from deepspeed_tpu.elasticity.config import (ElasticityConfig, ElasticityConfigError,
+                                             ElasticityError,
+                                             ElasticityIncompatibleWorldSize)
+from deepspeed_tpu.elasticity.constants import (ELASTICITY,
+                                                IGNORE_NON_ELASTIC_BATCH_INFO,
+                                                IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT,
+                                                LATEST_ELASTICITY_VERSION,
+                                                MINIMUM_DEEPSPEED_VERSION)
+from deepspeed_tpu.utils.logging import logger
+
+# runtime/constants imported lazily in _compat_check to avoid import cycles
+
+
+def _lcm(a: int, b: int) -> int:
+    return a * b // gcd(a, b)
+
+
+def _highly_composite_numbers(limit: int):
+    """Highly composite numbers (record-setting divisor counts) up to ``limit``.
+
+    The reference ships a hardcoded table (elasticity.py:19-58); we generate the
+    same mathematical sequence.  HCNs are products of primorials, so candidates
+    are searched over smooth numbers rather than a full sieve.
+    """
+    primes = [2, 3, 5, 7, 11, 13, 17]
+
+    def divisor_count(exps):
+        n = 1
+        for e in exps:
+            n *= (e + 1)
+        return n
+
+    candidates = {}
+
+    def rec(i, value, exps):
+        if i == len(primes):
+            candidates[value] = divisor_count(exps)
+            return
+        max_e = exps[i - 1] if i > 0 else 64
+        e = 0
+        v = value
+        while e <= max_e:
+            rec(i + 1, v, exps + [e])
+            e += 1
+            v *= primes[i]
+            if v > limit:
+                break
+
+    rec(0, 1, [])
+    hcns = []
+    best = 0
+    for n in sorted(candidates):
+        if candidates[n] > best:
+            best = candidates[n]
+            hcns.append(n)
+    return hcns
+
+
+_HCN_CACHE = None
+
+
+def _hcn_list():
+    global _HCN_CACHE
+    if _HCN_CACHE is None:
+        _HCN_CACHE = _highly_composite_numbers(720720)
+    return _HCN_CACHE
+
+
+def get_candidate_batch_sizes(base_list, max_acceptable_batch_size):
+    """For each base size, scale by the largest highly-composite number that
+    keeps base*hcn <= max (reference semantics, elasticity.py:61-73).  Note the
+    reference quirk: a base larger than max is itself kept as a candidate."""
+    candidates = set()
+    for base in base_list:
+        batch_size = base
+        for hcn in _hcn_list():
+            scaled = base * hcn
+            if scaled > max_acceptable_batch_size:
+                break
+            batch_size = scaled
+        candidates.add(batch_size)
+    return list(candidates)
+
+
+def get_valid_gpus(batch_size, micro_batches, min_valid_gpus, max_valid_gpus):
+    """World size w is valid iff some micro-batch mb divides batch_size and w
+    divides batch_size//mb (reference: elasticity.py:76-91)."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb != 0:
+            continue
+        per_gpu_total = batch_size // mb
+        for w in range(1, per_gpu_total + 1):
+            if per_gpu_total % w == 0 and min_valid_gpus <= w <= max_valid_gpus:
+                valid.add(w)
+    return sorted(valid)
+
+
+def get_best_candidates(candidate_batch_sizes, micro_batches, min_gpus, max_gpus,
+                        prefer_larger):
+    max_valid_gpus = 0
+    valid_gpus = None
+    final_batch_size = int(min(micro_batches))
+    for batch_size in candidate_batch_sizes:
+        current_valid_gpus = get_valid_gpus(batch_size, micro_batches, min_gpus, max_gpus)
+        if (len(current_valid_gpus) > max_valid_gpus
+                or (len(current_valid_gpus) == max_valid_gpus
+                    and ((prefer_larger and batch_size > final_batch_size)
+                         or (not prefer_larger and batch_size < final_batch_size)))):
+            max_valid_gpus = len(current_valid_gpus)
+            valid_gpus = current_valid_gpus
+            final_batch_size = batch_size
+    return final_batch_size, valid_gpus
+
+
+def _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size, min_gpus=None,
+                             max_gpus=None, prefer_larger=True):
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
+    assert all(mb <= max_acceptable_batch_size for mb in micro_batches), (
+        f"All micro batches must be <= max_acceptable_batch_size "
+        f"{max_acceptable_batch_size}")
+    # bases = each micro batch + the lcm of all of them
+    base_list = list(micro_batches) + [reduce(_lcm, micro_batches)]
+    candidates = get_candidate_batch_sizes(base_list, max_acceptable_batch_size)
+    return get_best_candidates(candidates, micro_batches, min_gpus, max_gpus, prefer_larger)
+
+
+def _parse_version(version_str: str):
+    import re
+
+    m = re.search(r"^(\d+)\.(\d+)(?:\.(\d+))?", version_str)
+    if m is None:
+        raise ElasticityConfigError(
+            f"Unable to parse version {version_str!r}; expected major.minor[.patch]")
+    return int(m.group(1)), int(m.group(2)), int(m.group(3) or 0)
+
+
+def _compatible_version_check(target_version: str):
+    """Guard against elastic configs scheduled for an incompatible runtime
+    (reference: elasticity.py minimum-version check)."""
+    min_v = _parse_version(MINIMUM_DEEPSPEED_VERSION)
+    trg_v = _parse_version(target_version)
+    if trg_v < min_v:
+        raise ElasticityError(
+            f"Target version {target_version} is below the minimum version "
+            f"{MINIMUM_DEEPSPEED_VERSION} supporting elasticity")
+    return True
+
+
+def elasticity_enabled(ds_config: dict) -> bool:
+    if ELASTICITY not in ds_config:
+        return False
+    return ds_config[ELASTICITY].get("enabled", False)
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: dict):
+    """Verify the scheduler-time elastic config (env var) matches runtime config.
+
+    Reference behavior (elasticity.py:227-237): a hash of the elastic config is
+    stashed in the environment by the scheduler; if present it must match.
+    """
+    import os
+    env_key = "DEEPSPEED_ELASTICITY_CONFIG"
+    if env_key in os.environ:
+        scheduler_config = json.loads(os.environ[env_key])
+        scheduler_hash = hashlib.sha1(
+            json.dumps(scheduler_config, sort_keys=True).encode()).hexdigest()
+        runtime_hash = hashlib.sha1(
+            json.dumps(runtime_elastic_config_dict, sort_keys=True).encode()).hexdigest()
+        if scheduler_hash != runtime_hash:
+            raise ElasticityConfigError(
+                "Elastic config changed between scheduling and runtime; "
+                "elastic config is immutable once scheduled")
+
+
+def compute_elastic_config(ds_config: dict, target_deepspeed_version: str,
+                           world_size: int = 0):
+    """Compute (final_batch_size, valid_gpus[, micro_batch]) from ds_config.
+
+    With world_size > 0 also returns the micro-batch to use at that world size
+    (largest compatible micro-batch when prefer_larger).
+    """
+    if not isinstance(ds_config, dict):
+        raise ValueError(f"Expected ds_config dict, got {type(ds_config)}")
+    if ELASTICITY not in ds_config:
+        raise ElasticityConfigError(f"'{ELASTICITY}' is missing from config json")
+    elastic_config_dict = ds_config[ELASTICITY]
+    if not elastic_config_dict.get("enabled", False):
+        raise ElasticityConfigError("Elasticity is disabled; set 'enabled': true")
+    elastic_config = ElasticityConfig(elastic_config_dict)
+
+    if float(elastic_config.version) > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            f"Unsupported elasticity version {elastic_config.version}, "
+            f"latest is {LATEST_ELASTICITY_VERSION}")
+
+    _compatible_version_check(target_deepspeed_version)
+
+    if float(elastic_config.version) == 0.1:
+        final_batch_size, valid_gpus = _get_compatible_gpus_v01(
+            micro_batches=elastic_config.micro_batches,
+            max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
+            min_gpus=elastic_config.min_gpus,
+            max_gpus=elastic_config.max_gpus,
+            prefer_larger=elastic_config.prefer_larger_batch_size)
+        final_batch_size = int(final_batch_size)
+    else:
+        raise NotImplementedError(
+            f"Unable to find elastic logic for version: {elastic_config.version}")
+
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityIncompatibleWorldSize(
+                f"World size {world_size} is not valid for this elastic config; "
+                f"valid world sizes: {valid_gpus}")
+        # pick the largest micro batch that divides the per-replica batch
+        micro_batch = None
+        per_replica = final_batch_size // world_size
+        for mbsz in sorted(set(elastic_config.micro_batches), reverse=True):
+            if per_replica % mbsz == 0:
+                micro_batch = mbsz
+                break
+        assert micro_batch is not None, (
+            f"Unable to find divisible micro batch: world_size={world_size}, "
+            f"final_batch_size={final_batch_size}, micro_batches="
+            f"{elastic_config.micro_batches}")
+        return final_batch_size, valid_gpus, micro_batch
+
+    return final_batch_size, valid_gpus

@@ -1,0 +1,461 @@
+"""DeepSpeedConfig: ds_config JSON -> typed config object.
+
+Key-for-key parity with the reference config system (reference:
+deepspeed/runtime/config.py:515-783), including the 6-case batch-size
+triangulation (:675) and elasticity integration (:538-592).  TPU extensions
+(bf16, mesh) are additive.
+"""
+import json
+import os
+
+from deepspeed_tpu.elasticity import (compute_elastic_config, elasticity_enabled,
+                                      ensure_immutable_elastic_config)
+from deepspeed_tpu.elasticity.config import (ElasticityConfigError,
+                                             ElasticityIncompatibleWorldSize)
+from deepspeed_tpu.elasticity.constants import (IGNORE_NON_ELASTIC_BATCH_INFO,
+                                                IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+from deepspeed_tpu.profiling.config import DeepSpeedFlopsProfilerConfig
+from deepspeed_tpu.runtime.activation_checkpointing.config import \
+    DeepSpeedActivationCheckpointingConfig
+from deepspeed_tpu.runtime.config_utils import (dict_raise_error_on_duplicate_keys,
+                                                get_scalar_param)
+from deepspeed_tpu.runtime.constants import *  # noqa: F401,F403
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.constants import (ZERO_OPTIMIZATION,
+                                                  ZERO_OPTIMIZATION_DISABLED)
+from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.version import __version__
+
+TENSOR_CORE_ALIGN_SIZE = 8
+# optimizer-name constants come from runtime/constants.py via the star import
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+def get_fp16_enabled(param_dict):
+    if FP16 in param_dict:
+        return get_scalar_param(param_dict[FP16], FP16_ENABLED, FP16_ENABLED_DEFAULT)
+    return False
+
+
+def get_bf16_enabled(param_dict):
+    if BF16 in param_dict:
+        return get_scalar_param(param_dict[BF16], BF16_ENABLED, BF16_ENABLED_DEFAULT)
+    return False
+
+
+def get_amp_enabled(param_dict):
+    if AMP in param_dict:
+        return get_scalar_param(param_dict[AMP], AMP_ENABLED, AMP_ENABLED_DEFAULT)
+    return False
+
+
+def get_amp_params(param_dict):
+    if AMP in param_dict:
+        d = dict(param_dict[AMP])
+        d.pop(AMP_ENABLED, None)
+        return d
+    return False
+
+
+def get_loss_scale(param_dict):
+    if get_fp16_enabled(param_dict):
+        return get_scalar_param(param_dict[FP16], FP16_LOSS_SCALE, FP16_LOSS_SCALE_DEFAULT)
+    return FP16_LOSS_SCALE_DEFAULT
+
+
+def get_initial_dynamic_scale(param_dict):
+    if get_fp16_enabled(param_dict):
+        power = get_scalar_param(param_dict[FP16], FP16_INITIAL_SCALE_POWER,
+                                 FP16_INITIAL_SCALE_POWER_DEFAULT)
+    else:
+        power = FP16_INITIAL_SCALE_POWER_DEFAULT
+    return 2 ** power
+
+
+def get_dynamic_loss_scale_args(param_dict):
+    loss_scale_args = None
+    if get_fp16_enabled(param_dict):
+        fp16_dict = param_dict[FP16]
+        dynamic_props = [FP16_INITIAL_SCALE_POWER, FP16_LOSS_SCALE_WINDOW,
+                         FP16_MIN_LOSS_SCALE, FP16_HYSTERESIS]
+        if any(prop in fp16_dict for prop in dynamic_props):
+            init_scale = get_scalar_param(fp16_dict, FP16_INITIAL_SCALE_POWER,
+                                          FP16_INITIAL_SCALE_POWER_DEFAULT)
+            scale_window = get_scalar_param(fp16_dict, FP16_LOSS_SCALE_WINDOW,
+                                            FP16_LOSS_SCALE_WINDOW_DEFAULT)
+            delayed_shift = get_scalar_param(fp16_dict, FP16_HYSTERESIS,
+                                             FP16_HYSTERESIS_DEFAULT)
+            min_loss_scale = get_scalar_param(fp16_dict, FP16_MIN_LOSS_SCALE,
+                                              FP16_MIN_LOSS_SCALE_DEFAULT)
+            loss_scale_args = {
+                "init_scale": 2 ** init_scale,
+                "scale_window": scale_window,
+                "delayed_shift": delayed_shift,
+                "min_scale": min_loss_scale,
+            }
+    return loss_scale_args
+
+
+def get_gradient_accumulation_steps(param_dict):
+    return get_scalar_param(param_dict, GRADIENT_ACCUMULATION_STEPS,
+                            GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+
+
+def get_sparse_gradients_enabled(param_dict):
+    return get_scalar_param(param_dict, SPARSE_GRADIENTS, SPARSE_GRADIENTS_DEFAULT)
+
+
+def get_zero_optimization(param_dict):
+    return get_scalar_param(param_dict, ZERO_OPTIMIZATION, ZERO_OPTIMIZATION_DISABLED)
+
+
+def get_allow_untested_optimizer(param_dict):
+    return get_scalar_param(param_dict, ZERO_ALLOW_UNTESTED_OPTIMIZER,
+                            ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT)
+
+
+def get_gradient_clipping(param_dict):
+    return get_scalar_param(param_dict, GRADIENT_CLIPPING, GRADIENT_CLIPPING_DEFAULT)
+
+
+def get_sparse_attention(param_dict):
+    if SPARSE_ATTENTION in param_dict:
+        sparsity = param_dict[SPARSE_ATTENTION]
+        mode = get_scalar_param(sparsity, SPARSE_ATTENTION_MODE, SPARSE_ATTENTION_MODE_DEFAULT)
+        sparsity = dict(sparsity)
+        sparsity[SPARSE_ATTENTION_MODE] = mode
+        return sparsity
+    return None
+
+
+def get_optimizer_name(param_dict):
+    if OPTIMIZER in param_dict and TYPE in param_dict[OPTIMIZER]:
+        return param_dict[OPTIMIZER][TYPE]
+    return OPTIMIZER_TYPE_DEFAULT
+
+
+def get_optimizer_params(param_dict):
+    if get_optimizer_name(param_dict) is not None and \
+            OPTIMIZER_PARAMS in param_dict[OPTIMIZER]:
+        return param_dict[OPTIMIZER][OPTIMIZER_PARAMS]
+    return None
+
+
+def get_optimizer_gradient_clipping(param_dict):
+    optimizer_params = get_optimizer_params(param_dict)
+    if optimizer_params is not None and MAX_GRAD_NORM in optimizer_params:
+        return optimizer_params[MAX_GRAD_NORM]
+    return None
+
+
+def get_optimizer_legacy_fusion(param_dict):
+    if OPTIMIZER in param_dict and LEGACY_FUSION in param_dict[OPTIMIZER]:
+        return param_dict[OPTIMIZER][LEGACY_FUSION]
+    return LEGACY_FUSION_DEFAULT
+
+
+def get_scheduler_name(param_dict):
+    if SCHEDULER in param_dict and TYPE in param_dict[SCHEDULER]:
+        return param_dict[SCHEDULER][TYPE]
+    return SCHEDULER_TYPE_DEFAULT
+
+
+def get_scheduler_params(param_dict):
+    if get_scheduler_name(param_dict) is not None and \
+            SCHEDULER_PARAMS in param_dict[SCHEDULER]:
+        return param_dict[SCHEDULER][SCHEDULER_PARAMS]
+    return None
+
+
+def get_train_batch_size(param_dict):
+    return get_scalar_param(param_dict, TRAIN_BATCH_SIZE, TRAIN_BATCH_SIZE_DEFAULT)
+
+
+def get_train_micro_batch_size_per_gpu(param_dict):
+    return get_scalar_param(param_dict, TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                            TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+
+
+def get_wall_clock_breakdown(param_dict):
+    return get_scalar_param(param_dict, WALL_CLOCK_BREAKDOWN, WALL_CLOCK_BREAKDOWN_DEFAULT)
+
+
+def get_memory_breakdown(param_dict):
+    return get_scalar_param(param_dict, MEMORY_BREAKDOWN, MEMORY_BREAKDOWN_DEFAULT)
+
+
+def get_tensorboard_enabled(param_dict):
+    if TENSORBOARD in param_dict:
+        return get_scalar_param(param_dict[TENSORBOARD], TENSORBOARD_ENABLED,
+                                TENSORBOARD_ENABLED_DEFAULT)
+    return False
+
+
+def get_tensorboard_output_path(param_dict):
+    if get_tensorboard_enabled(param_dict):
+        return get_scalar_param(param_dict[TENSORBOARD], TENSORBOARD_OUTPUT_PATH,
+                                TENSORBOARD_OUTPUT_PATH_DEFAULT)
+    return TENSORBOARD_OUTPUT_PATH_DEFAULT
+
+
+def get_tensorboard_job_name(param_dict):
+    if get_tensorboard_enabled(param_dict):
+        return get_scalar_param(param_dict[TENSORBOARD], TENSORBOARD_JOB_NAME,
+                                TENSORBOARD_JOB_NAME_DEFAULT)
+    return TENSORBOARD_JOB_NAME_DEFAULT
+
+
+def get_steps_per_print(param_dict):
+    return get_scalar_param(param_dict, STEPS_PER_PRINT, STEPS_PER_PRINT_DEFAULT)
+
+
+def get_disable_allgather(param_dict):
+    return get_scalar_param(param_dict, DISABLE_ALLGATHER, DISABLE_ALLGATHER_DEFAULT)
+
+
+def get_dump_state(param_dict):
+    return get_scalar_param(param_dict, DUMP_STATE, DUMP_STATE_DEFAULT)
+
+
+def get_gradient_predivide_factor(param_dict):
+    return get_scalar_param(param_dict, GRADIENT_PREDIVIDE_FACTOR,
+                            GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+
+
+def get_prescale_gradients(param_dict):
+    return get_scalar_param(param_dict, PRESCALE_GRADIENTS, PRESCALE_GRADIENTS_DEFAULT)
+
+
+def get_allreduce_always_fp32(param_dict):
+    return get_scalar_param(param_dict, ALLREDUCE_ALWAYS_FP32, ALLREDUCE_ALWAYS_FP32_DEFAULT)
+
+
+def get_progressive_layer_drop(param_dict):
+    d = param_dict.get(PROGRESSIVE_LAYER_DROP, {})
+    enabled = get_scalar_param(d, PLD_ENABLED, PLD_ENABLED_DEFAULT)
+    theta = get_scalar_param(d, PLD_THETA, PLD_THETA_DEFAULT)
+    gamma = get_scalar_param(d, PLD_GAMMA, PLD_GAMMA_DEFAULT)
+    return enabled, theta, gamma
+
+
+def get_mesh_shape(param_dict):
+    """TPU extension: explicit mesh axis sizes {"data": -1, "model": 1, "pipe": 1}.
+
+    -1 for the data axis means "whatever is left over" after model/pipe.
+    """
+    d = param_dict.get(MESH, {})
+    return {
+        MESH_PIPE_AXIS: d.get(MESH_PIPE_AXIS, 1),
+        MESH_DATA_AXIS: d.get(MESH_DATA_AXIS, -1),
+        MESH_MODEL_AXIS: d.get(MESH_MODEL_AXIS, 1),
+    }
+
+
+def get_pipeline_config(param_dict):
+    d = param_dict.get(PIPELINE, {})
+    return {
+        PIPELINE_STAGES: d.get(PIPELINE_STAGES, PIPELINE_STAGES_DEFAULT),
+        PIPELINE_PARTITION: d.get(PIPELINE_PARTITION, PIPELINE_PARTITION_DEFAULT),
+        PIPELINE_SEED_LAYERS: d.get(PIPELINE_SEED_LAYERS, PIPELINE_SEED_LAYERS_DEFAULT),
+        PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL: d.get(
+            PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL,
+            PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT),
+    }
+
+
+class DeepSpeedConfig:
+    def __init__(self, json_file_or_dict, mpu=None, param_dict=None, world_size=None):
+        if param_dict is None:
+            if isinstance(json_file_or_dict, dict):
+                self._param_dict = json_file_or_dict
+            else:
+                with open(json_file_or_dict, "r") as f:
+                    self._param_dict = json.load(
+                        f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        else:
+            self._param_dict = param_dict
+
+        if world_size is not None:
+            self.world_size = world_size
+        elif mpu is None:
+            self.world_size = int(os.environ.get("WORLD_SIZE", "1"))
+        else:
+            self.world_size = mpu.get_data_parallel_world_size()
+
+        self.elasticity_enabled = elasticity_enabled(self._param_dict)
+        if self.elasticity_enabled:
+            final_batch_size, valid_gpus, micro_batch_size = compute_elastic_config(
+                ds_config=self._param_dict,
+                target_deepspeed_version=__version__,
+                world_size=self.world_size)
+            elastic_dict = self._param_dict["elasticity"]
+            ensure_immutable_elastic_config(elastic_dict)
+            ignore_non_elastic = elastic_dict.get(IGNORE_NON_ELASTIC_BATCH_INFO,
+                                                  IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+            if not ignore_non_elastic:
+                batch_params = [TRAIN_BATCH_SIZE, TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                                GRADIENT_ACCUMULATION_STEPS]
+                if any(p in self._param_dict for p in batch_params):
+                    raise ElasticityConfigError(
+                        "One or more batch-related parameters were found in your "
+                        f"ds_config ({batch_params}). These parameters *cannot* be "
+                        "used with elasticity; they are computed from the elastic "
+                        f"config. Set {IGNORE_NON_ELASTIC_BATCH_INFO}:true to "
+                        "suppress this error")
+            gas = final_batch_size // (micro_batch_size * self.world_size)
+            self._param_dict[TRAIN_BATCH_SIZE] = final_batch_size
+            self._param_dict[TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro_batch_size
+            self._param_dict[GRADIENT_ACCUMULATION_STEPS] = gas
+            logger.info(
+                f"Elasticity: final batch size {final_batch_size}, "
+                f"micro batch {micro_batch_size}, gas {gas}, valid world sizes {valid_gpus}")
+
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    def _initialize_params(self, param_dict):
+        self.train_batch_size = get_train_batch_size(param_dict)
+        self.train_micro_batch_size_per_gpu = get_train_micro_batch_size_per_gpu(param_dict)
+        self.gradient_accumulation_steps = get_gradient_accumulation_steps(param_dict)
+        self.steps_per_print = get_steps_per_print(param_dict)
+        self.dump_state = get_dump_state(param_dict)
+
+        self.disable_allgather = get_disable_allgather(param_dict)
+        self.allreduce_always_fp32 = get_allreduce_always_fp32(param_dict)
+        self.prescale_gradients = get_prescale_gradients(param_dict)
+        self.gradient_predivide_factor = get_gradient_predivide_factor(param_dict)
+        self.sparse_gradients_enabled = get_sparse_gradients_enabled(param_dict)
+
+        self.zero_config = DeepSpeedZeroConfig(param_dict)
+        self.zero_optimization_stage = self.zero_config.stage
+        self.zero_enabled = self.zero_optimization_stage > 0
+
+        self.activation_checkpointing_config = \
+            DeepSpeedActivationCheckpointingConfig(param_dict)
+        self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(param_dict)
+
+        self.fp16_enabled = get_fp16_enabled(param_dict)
+        self.bf16_enabled = get_bf16_enabled(param_dict)
+        self.amp_enabled = get_amp_enabled(param_dict)
+        self.amp_params = get_amp_params(param_dict)
+        self.loss_scale = get_loss_scale(param_dict)
+        self.initial_dynamic_scale = get_initial_dynamic_scale(param_dict)
+        self.dynamic_loss_scale_args = get_dynamic_loss_scale_args(param_dict)
+
+        self.optimizer_name = get_optimizer_name(param_dict)
+        if self.optimizer_name is not None and \
+                self.optimizer_name.lower() in DEEPSPEED_OPTIMIZERS:
+            self.optimizer_name = self.optimizer_name.lower()
+        self.optimizer_params = get_optimizer_params(param_dict)
+        self.optimizer_legacy_fusion = get_optimizer_legacy_fusion(param_dict)
+
+        self.zero_allow_untested_optimizer = get_allow_untested_optimizer(param_dict)
+
+        self.scheduler_name = get_scheduler_name(param_dict)
+        self.scheduler_params = get_scheduler_params(param_dict)
+
+        self.wall_clock_breakdown = get_wall_clock_breakdown(param_dict)
+        self.memory_breakdown = get_memory_breakdown(param_dict)
+        self.tensorboard_enabled = get_tensorboard_enabled(param_dict)
+        self.tensorboard_output_path = get_tensorboard_output_path(param_dict)
+        self.tensorboard_job_name = get_tensorboard_job_name(param_dict)
+
+        self.gradient_clipping = get_gradient_clipping(param_dict)
+        self.sparse_attention = get_sparse_attention(param_dict)
+
+        self.pld_enabled, self.pld_theta, self.pld_gamma = \
+            get_progressive_layer_drop(param_dict)
+
+        self.mesh_shape = get_mesh_shape(param_dict)
+        self.pipeline = get_pipeline_config(param_dict)
+
+    def _batch_assertion(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        assert train_batch > 0, f"Train batch size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, f"Micro batch size per gpu: {micro_batch} has to be greater than 0"
+        assert grad_acc > 0, f"Gradient accumulation steps: {grad_acc} has to be greater than 0"
+        assert train_batch == micro_batch * grad_acc * self.world_size, (
+            f"Check batch related parameters. train_batch_size is not equal "
+            f"to micro_batch_per_gpu * gradient_acc_step * world_size "
+            f"{train_batch} != {micro_batch} * {grad_acc} * {self.world_size}")
+
+    def _set_batch_related_parameters(self):
+        """The 6-case triangulation (reference: config.py:675)."""
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        # all three provided
+        if train_batch is not None and micro_batch is not None and grad_acc is not None:
+            return
+        # global + micro -> derive gas
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= self.world_size
+            self.gradient_accumulation_steps = grad_acc
+        # global + gas -> derive micro
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // self.world_size
+            micro_batch //= grad_acc
+            self.train_micro_batch_size_per_gpu = micro_batch
+        # micro + gas -> derive global
+        elif micro_batch is not None and grad_acc is not None:
+            self.train_batch_size = micro_batch * grad_acc * self.world_size
+        # global only
+        elif train_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train_batch // self.world_size
+        # micro only
+        elif micro_batch is not None:
+            self.train_batch_size = micro_batch * self.world_size
+            self.gradient_accumulation_steps = 1
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu needs "
+                "to be provided")
+
+    def _configure_train_batch_size(self):
+        self._set_batch_related_parameters()
+        self._batch_assertion()
+
+    def _do_sanity_check(self):
+        self._do_error_check()
+        self._do_warning_check()
+
+    def _do_error_check(self):
+        assert self.train_micro_batch_size_per_gpu, \
+            f"DeepSpeedConfig: {TRAIN_MICRO_BATCH_SIZE_PER_GPU} is not defined"
+        assert self.gradient_accumulation_steps, \
+            f"DeepSpeedConfig: {GRADIENT_ACCUMULATION_STEPS} is not defined"
+        if self.zero_enabled:
+            assert self.zero_optimization_stage <= 2, \
+                "DeepSpeedConfig: Max supported ZeRO stage is 2 (parity with reference)"
+
+    def _do_warning_check(self):
+        fp16_enabled = self.fp16_enabled or self.zero_enabled
+        vocabulary_size = self._param_dict.get("vocabulary_size", None)
+        if vocabulary_size and vocabulary_size % TENSOR_CORE_ALIGN_SIZE != 0:
+            logger.warning(
+                f"DeepSpeedConfig: vocabulary size {vocabulary_size} is not aligned "
+                f"to {TENSOR_CORE_ALIGN_SIZE}; may be suboptimal for MXU tiling")
+        if self.optimizer_params is not None and \
+                MAX_GRAD_NORM in self.optimizer_params and \
+                self.optimizer_params[MAX_GRAD_NORM] > 0:
+            if fp16_enabled:
+                logger.warning(
+                    f"DeepSpeedConfig: In FP16 mode, DeepSpeed will pass "
+                    f"{MAX_GRAD_NORM}:{self.optimizer_params[MAX_GRAD_NORM]} to the "
+                    f"fp16 wrapper; set gradient_clipping instead")
+
+    def print(self, name):
+        logger.info(f"{name}:")
+        for key, value in sorted(self.__dict__.items()):
+            if key != "_param_dict":
+                logger.info(f"  {key} {value}")
+        logger.info(f"  json = {json.dumps(self._param_dict, sort_keys=True, indent=2)}")

@@ -1,0 +1,325 @@
+"""LR schedules with the reference's config surface.
+
+Reference: deepspeed/runtime/lr_schedules.py (LRRangeTest :301, OneCycle :408,
+WarmupLR :677, WarmupDecayLR :761, add_tuning_arguments :54).
+
+In the TPU build a scheduler is a host-side object the engine queries each
+optimizer step; the value is fed into the jitted update as a scalar argument
+(so no recompilation per step).  Each scheduler also exposes ``lr_at(step)``
+— a pure function usable inside jit for fully-fused schedules.
+"""
+import argparse
+import math
+
+from deepspeed_tpu.utils.logging import logger
+
+LR_SCHEDULE = "lr_schedule"
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR]
+
+LR_RANGE_TEST_MIN_LR = "lr_range_test_min_lr"
+LR_RANGE_TEST_STEP_RATE = "lr_range_test_step_rate"
+LR_RANGE_TEST_STEP_SIZE = "lr_range_test_step_size"
+LR_RANGE_TEST_STAIRCASE = "lr_range_test_staircase"
+
+EDGE_VALUE = "edge_value"
+MID_VALUE = "mid_value"
+
+CYCLE_FIRST_STEP_SIZE = "cycle_first_step_size"
+CYCLE_FIRST_STAIR_COUNT = "cycle_first_stair_count"
+CYCLE_SECOND_STEP_SIZE = "cycle_second_step_size"
+CYCLE_SECOND_STAIR_COUNT = "cycle_second_stair_count"
+DECAY_STEP_SIZE = "decay_step_size"
+
+CYCLE_MIN_LR = "cycle_min_lr"
+CYCLE_MAX_LR = "cycle_max_lr"
+DECAY_LR_RATE = "decay_lr_rate"
+
+CYCLE_MIN_MOM = "cycle_min_mom"
+CYCLE_MAX_MOM = "cycle_max_mom"
+DECAY_MOM_RATE = "decay_mom_rate"
+
+WARMUP_MIN_LR = "warmup_min_lr"
+WARMUP_MAX_LR = "warmup_max_lr"
+WARMUP_NUM_STEPS = "warmup_num_steps"
+TOTAL_NUM_STEPS = "total_num_steps"
+
+
+def add_tuning_arguments(parser):
+    group = parser.add_argument_group("Convergence Tuning", "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help="LR schedule for training.")
+    # LR range test
+    group.add_argument("--lr_range_test_min_lr", type=float, default=0.001)
+    group.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
+    group.add_argument("--lr_range_test_step_size", type=int, default=1000)
+    group.add_argument("--lr_range_test_staircase", type=bool, default=False)
+    # OneCycle
+    group.add_argument("--cycle_first_step_size", type=int, default=1000)
+    group.add_argument("--cycle_first_stair_count", type=int, default=-1)
+    group.add_argument("--cycle_second_step_size", type=int, default=-1)
+    group.add_argument("--cycle_second_stair_count", type=int, default=-1)
+    group.add_argument("--decay_step_size", type=int, default=1000)
+    group.add_argument("--cycle_min_lr", type=float, default=0.01)
+    group.add_argument("--cycle_max_lr", type=float, default=0.1)
+    group.add_argument("--decay_lr_rate", type=float, default=0.0)
+    group.add_argument("--cycle_min_mom", type=float, default=0.8)
+    group.add_argument("--cycle_max_mom", type=float, default=0.9)
+    group.add_argument("--decay_mom_rate", type=float, default=0.0)
+    # Warmup
+    group.add_argument("--warmup_min_lr", type=float, default=0)
+    group.add_argument("--warmup_max_lr", type=float, default=0.001)
+    group.add_argument("--warmup_num_steps", type=int, default=1000)
+    return parser
+
+
+def parse_arguments():
+    parser = argparse.ArgumentParser()
+    parser = add_tuning_arguments(parser)
+    lr_sched_args, unknown_args = parser.parse_known_args()
+    return lr_sched_args, unknown_args
+
+
+def override_lr_range_test_params(args, params):
+    if hasattr(args, LR_RANGE_TEST_MIN_LR) and args.lr_range_test_min_lr is not None:
+        params[LR_RANGE_TEST_MIN_LR] = args.lr_range_test_min_lr
+    if hasattr(args, LR_RANGE_TEST_STEP_RATE) and args.lr_range_test_step_rate is not None:
+        params[LR_RANGE_TEST_STEP_RATE] = args.lr_range_test_step_rate
+    if hasattr(args, LR_RANGE_TEST_STEP_SIZE) and args.lr_range_test_step_size is not None:
+        params[LR_RANGE_TEST_STEP_SIZE] = args.lr_range_test_step_size
+    if hasattr(args, LR_RANGE_TEST_STAIRCASE) and args.lr_range_test_staircase is not None:
+        params[LR_RANGE_TEST_STAIRCASE] = args.lr_range_test_staircase
+
+
+def override_1cycle_params(args, params):
+    for key in [CYCLE_FIRST_STEP_SIZE, CYCLE_FIRST_STAIR_COUNT, CYCLE_SECOND_STEP_SIZE,
+                CYCLE_SECOND_STAIR_COUNT, DECAY_STEP_SIZE, CYCLE_MIN_LR, CYCLE_MAX_LR,
+                DECAY_LR_RATE, CYCLE_MIN_MOM, CYCLE_MAX_MOM, DECAY_MOM_RATE]:
+        if hasattr(args, key) and getattr(args, key) is not None:
+            params[key] = getattr(args, key)
+
+
+def override_warmupLR_params(args, params):
+    for key in [WARMUP_MIN_LR, WARMUP_MAX_LR, WARMUP_NUM_STEPS]:
+        if hasattr(args, key) and getattr(args, key) is not None:
+            params[key] = getattr(args, key)
+
+
+def override_params(args, params):
+    override_lr_range_test_params(args, params)
+    override_1cycle_params(args, params)
+    override_warmupLR_params(args, params)
+
+
+def get_config_from_args(args):
+    if not hasattr(args, LR_SCHEDULE) or args.lr_schedule is None:
+        return None, "--{} not specified on command line".format(LR_SCHEDULE)
+    if args.lr_schedule not in VALID_LR_SCHEDULES:
+        return None, "{} is not supported LR schedule".format(args.lr_schedule)
+    config = {"type": args.lr_schedule, "params": {}}
+    if args.lr_schedule == LR_RANGE_TEST:
+        override_lr_range_test_params(args, config["params"])
+    elif args.lr_schedule == ONE_CYCLE:
+        override_1cycle_params(args, config["params"])
+    else:
+        override_warmupLR_params(args, config["params"])
+    return config, None
+
+
+class _LRSchedulerBase:
+    """Host-side scheduler.  Also usable as pure fn via lr_at(step)."""
+
+    def __init__(self, optimizer=None, last_batch_iteration=-1):
+        self.optimizer = optimizer  # engine object or None; kept for API parity
+        self.last_batch_iteration = last_batch_iteration
+
+    def lr_at(self, step):
+        raise NotImplementedError
+
+    def get_lr(self):
+        if self.last_batch_iteration < 0:
+            logger.warning("Attempting to get learning rate from scheduler before it has started")
+            return [0.0]
+        return [self.lr_at(self.last_batch_iteration)]
+
+    def get_last_lr(self):
+        assert getattr(self, "_last_lr", None) is not None, "need to call step() first"
+        return self._last_lr
+
+    def step(self, last_batch_iteration=None):
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+        lr = self.lr_at(self.last_batch_iteration)
+        self._last_lr = [lr]
+        if self.optimizer is not None and hasattr(self.optimizer, "set_lr"):
+            self.optimizer.set_lr(lr)
+        return lr
+
+    def state_dict(self):
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd):
+        self.last_batch_iteration = sd["last_batch_iteration"]
+
+
+class LRRangeTest(_LRSchedulerBase):
+    """LR range test (Smith): lr = min_lr * (1 + step/size * rate), optionally staircase.
+
+    Reference: lr_schedules.py:301-405.
+    """
+
+    def __init__(self, optimizer=None, lr_range_test_min_lr=1e-3,
+                 lr_range_test_step_size=2000, lr_range_test_step_rate=1.0,
+                 lr_range_test_staircase=False, last_batch_iteration=-1):
+        super().__init__(optimizer, last_batch_iteration)
+        if lr_range_test_min_lr <= 0:
+            raise ValueError(f"invalid min_lr {lr_range_test_min_lr}")
+        self.min_lr = lr_range_test_min_lr
+        self.step_size = lr_range_test_step_size
+        self.step_rate = lr_range_test_step_rate
+        self.staircase = lr_range_test_staircase
+
+    def lr_at(self, step):
+        step = max(0, step)
+        if self.staircase:
+            interval = float(step // self.step_size)
+        else:
+            interval = float(step) / self.step_size
+        return self.min_lr * (1 + self.step_rate * interval)
+
+
+class OneCycle(_LRSchedulerBase):
+    """1-cycle policy: linear up over first phase, linear down over second,
+    then (optional) decay.  Momentum cycles inversely.
+
+    Reference: lr_schedules.py:408-674.
+    """
+
+    def __init__(self, optimizer=None, cycle_min_lr=1e-3, cycle_max_lr=1e-2,
+                 decay_lr_rate=0., cycle_first_step_size=2000,
+                 cycle_second_step_size=None, cycle_first_stair_count=0,
+                 cycle_second_stair_count=None, decay_step_size=0,
+                 cycle_momentum=True, cycle_min_mom=0.8, cycle_max_mom=0.9,
+                 decay_mom_rate=0., last_batch_iteration=-1):
+        super().__init__(optimizer, last_batch_iteration)
+        self.cycle_min_lr = cycle_min_lr
+        self.cycle_max_lr = cycle_max_lr
+        self.decay_lr_rate = decay_lr_rate
+        self.first_step_size = cycle_first_step_size
+        self.second_step_size = cycle_second_step_size \
+            if cycle_second_step_size is not None else cycle_first_step_size
+        self.first_stair_count = cycle_first_stair_count
+        self.second_stair_count = cycle_second_stair_count \
+            if cycle_second_stair_count is not None else cycle_first_stair_count
+        self.decay_step_size = decay_step_size
+        self.total_cycle_size = self.first_step_size + self.second_step_size
+        self.cycle_momentum = cycle_momentum
+        self.cycle_min_mom = cycle_min_mom
+        self.cycle_max_mom = cycle_max_mom
+        self.decay_mom_rate = decay_mom_rate
+
+    def _staircase_interval(self, step_size, stair_count, progress):
+        if stair_count in (0, -1) or stair_count is None:
+            return progress / step_size
+        stair_size = step_size / stair_count
+        return math.floor(progress / stair_size) * stair_size / step_size
+
+    def lr_at(self, step):
+        step = max(0, step)
+        if step < self.total_cycle_size:
+            if step < self.first_step_size:  # ramp up
+                frac = self._staircase_interval(self.first_step_size,
+                                                self.first_stair_count, step)
+                return self.cycle_min_lr + (self.cycle_max_lr - self.cycle_min_lr) * min(1.0, frac)
+            # ramp down
+            progress = step - self.first_step_size
+            frac = self._staircase_interval(self.second_step_size,
+                                            self.second_stair_count, progress)
+            return self.cycle_max_lr - (self.cycle_max_lr - self.cycle_min_lr) * min(1.0, frac)
+        # decay phase
+        if self.decay_step_size > 0:
+            decay_steps = (step - self.total_cycle_size) // self.decay_step_size
+        else:
+            decay_steps = step - self.total_cycle_size
+        return self.cycle_min_lr / (1.0 + decay_steps * self.decay_lr_rate) \
+            if self.decay_lr_rate > 0 else self.cycle_min_lr
+
+    def mom_at(self, step):
+        if not self.cycle_momentum:
+            return self.cycle_max_mom
+        step = max(0, step)
+        if step < self.total_cycle_size:
+            if step < self.first_step_size:  # momentum goes down while lr goes up
+                frac = float(step) / self.first_step_size
+                return self.cycle_max_mom - (self.cycle_max_mom - self.cycle_min_mom) * min(1.0, frac)
+            progress = step - self.first_step_size
+            frac = float(progress) / self.second_step_size
+            return self.cycle_min_mom + (self.cycle_max_mom - self.cycle_min_mom) * min(1.0, frac)
+        if self.decay_step_size > 0:
+            decay_steps = (step - self.total_cycle_size) // self.decay_step_size
+        else:
+            decay_steps = step - self.total_cycle_size
+        return self.cycle_max_mom * (1.0 + decay_steps * self.decay_mom_rate) \
+            if self.decay_mom_rate > 0 else self.cycle_max_mom
+
+    def get_mom(self):
+        return [self.mom_at(max(0, self.last_batch_iteration))]
+
+
+class WarmupLR(_LRSchedulerBase):
+    """Linear warmup from min_lr to max_lr over warmup_num_steps, then constant.
+
+    Reference: lr_schedules.py:677-758.
+    """
+
+    def __init__(self, optimizer=None, warmup_min_lr=0.0, warmup_max_lr=0.001,
+                 warmup_num_steps=1000, last_batch_iteration=-1):
+        super().__init__(optimizer, last_batch_iteration)
+        self.min_lr = warmup_min_lr
+        self.max_lr = warmup_max_lr
+        self.warmup_num_steps = warmup_num_steps
+        self.inverse_log_warm_up = 1.0 / math.log(max(2, warmup_num_steps))
+
+    def _get_gamma(self, step):
+        if step < self.warmup_num_steps:
+            return self.inverse_log_warm_up * math.log(step + 1)
+        return 1.0
+
+    def lr_at(self, step):
+        step = max(0, step)
+        gamma = self._get_gamma(step)
+        return self.min_lr + (self.max_lr - self.min_lr) * gamma
+
+
+class WarmupDecayLR(WarmupLR):
+    """WarmupLR followed by linear decay to 0 at total_num_steps.
+
+    Reference: lr_schedules.py:761-809.
+    """
+
+    def __init__(self, optimizer=None, total_num_steps=1000, warmup_min_lr=0.0,
+                 warmup_max_lr=0.001, warmup_num_steps=1000, last_batch_iteration=-1):
+        self.total_num_steps = total_num_steps
+        super().__init__(optimizer, warmup_min_lr, warmup_max_lr, warmup_num_steps,
+                         last_batch_iteration)
+        if self.total_num_steps < self.warmup_num_steps:
+            logger.warning(f"total_num_steps {total_num_steps} is less than "
+                           f"warmup_num_steps {warmup_num_steps}")
+
+    def _get_gamma(self, step):
+        if step < self.warmup_num_steps:
+            return self.inverse_log_warm_up * math.log(step + 1)
+        return max(0.0, float(self.total_num_steps - step) /
+                   float(max(1.0, self.total_num_steps - self.warmup_num_steps)))
+
+
+SCHEDULER_REGISTRY = {
+    LR_RANGE_TEST: LRRangeTest,
+    ONE_CYCLE: OneCycle,
+    WARMUP_LR: WarmupLR,
+    WARMUP_DECAY_LR: WarmupDecayLR,
+}

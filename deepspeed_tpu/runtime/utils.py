@@ -1,0 +1,218 @@
+"""Runtime helper utilities.
+
+TPU-native analogs of reference deepspeed/runtime/utils.py: balanced layer
+partitioning (:311-377 partition_uniform/partition_balanced), PartitionedTensor
+(:395), overflow checking (:63-133), norm helpers (:170-294), memory reporting
+(:547).  Partitioning is pure Python; tensor ops are jnp.
+"""
+import math
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+# ---------------------------------------------------------------------------
+# Layer partitioning (pure python; used by PipelineModule)
+# ---------------------------------------------------------------------------
+
+def ensure_directory_exists(filename):
+    import os
+    dirname = os.path.dirname(filename)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+
+
+def partition_uniform(num_items: int, num_parts: int):
+    """Split ``num_items`` into ``num_parts`` contiguous chunks as evenly as possible.
+
+    Returns a list of ``num_parts + 1`` boundaries: part p owns
+    ``[parts[p], parts[p+1])``.
+    """
+    parts = [0] * (num_parts + 1)
+    if num_items <= num_parts:
+        for p in range(num_parts + 1):
+            parts[p] = min(p, num_items)
+        return parts
+    chunksize = num_items // num_parts
+    residual = num_items % num_parts
+    # the first `residual` parts get one extra item
+    parts = [p * chunksize + min(p, residual) for p in range(num_parts + 1)]
+    return parts
+
+
+def prefix_sum_inc(weights):
+    """Inclusive prefix sum."""
+    out = list(weights)
+    for i in range(1, len(out)):
+        out[i] += out[i - 1]
+    return out
+
+
+def _lprobe(weights, num_parts, bottleneck):
+    """Greedy probe: can ``weights`` be split into num_parts chains each <= bottleneck?"""
+    num_items = len(weights)
+    total_weight = weights[-1]
+    parts = [0] * (num_parts + 1)
+
+    bsum = bottleneck
+    chunksize = num_items // num_parts
+    step = chunksize
+    for p in range(1, num_parts):
+        while step < num_items and weights[step] < bsum:
+            step += chunksize
+        idx = int(np.searchsorted(weights[max(0, step - chunksize):step], bsum)) + \
+            max(0, step - chunksize)
+        if idx >= num_items:
+            parts[p:num_parts] = [num_items] * (num_parts - p)
+            break
+        parts[p] = idx
+        bsum = weights[idx - 1] + bottleneck if idx > 0 else bottleneck
+    parts[num_parts] = num_items
+    success = bsum >= total_weight
+    return parts, success
+
+
+def _rb_partition_balanced(weights, num_parts, eps):
+    """Binary search over the bottleneck value."""
+    total = weights[-1]
+    lower = total / num_parts
+    upper = total
+    while upper > lower + eps:
+        mid = lower + (upper - lower) / 2
+        _, success = _lprobe(weights, num_parts, mid)
+        if success:
+            upper = mid
+        else:
+            lower = mid
+    return upper
+
+
+def partition_balanced(weights, num_parts, eps=1e-3):
+    """Partition items with the given weights into parts minimizing the max part
+    weight (binary search over bottleneck + greedy probe), as in reference
+    runtime/utils.py:326-375."""
+    num_items = len(weights)
+    if num_items <= num_parts:
+        return partition_uniform(num_items, num_parts)
+    weights_ = prefix_sum_inc(weights)
+    bottleneck = _rb_partition_balanced(weights_, num_parts, eps=eps)
+    parts, success = _lprobe(weights_, num_parts, bottleneck + eps)
+    assert success
+    return parts
+
+
+# ---------------------------------------------------------------------------
+# Tensor helpers (jnp)
+# ---------------------------------------------------------------------------
+
+class PartitionedTensor:
+    """Shard a flat tensor across a mesh axis; ``full()`` re-materializes.
+
+    Functional analog of reference runtime/utils.py:395-505.  Used by the
+    pipeline engine to send model-parallel-partitioned activations.  Inside jit
+    use :func:`partition_and_slice` / :func:`gather_full` directly; this object
+    wrapper serves host-level code and tests.
+    """
+
+    def __init__(self, tensor, axis_size: int, axis_index: int):
+        import jax.numpy as jnp
+
+        self.orig_shape = tuple(tensor.shape)
+        self.orig_size = int(np.prod(self.orig_shape))
+        self.axis_size = axis_size
+        self.axis_index = axis_index
+        flat = jnp.ravel(tensor)
+        padded = self.padded_size(self.orig_size, axis_size)
+        if padded != self.orig_size:
+            flat = jnp.pad(flat, (0, padded - self.orig_size))
+        self.part_size = padded // axis_size
+        self.local_data = flat[axis_index * self.part_size:(axis_index + 1) * self.part_size]
+
+    @staticmethod
+    def padded_size(numel: int, parts: int) -> int:
+        return math.ceil(numel / parts) * parts
+
+    def to_meta(self):
+        return {"orig_shape": self.orig_shape, "orig_size": self.orig_size,
+                "axis_size": self.axis_size, "part_size": self.part_size}
+
+    @classmethod
+    def from_parts(cls, parts_list, meta):
+        import jax.numpy as jnp
+
+        obj = cls.__new__(cls)
+        obj.orig_shape = tuple(meta["orig_shape"])
+        obj.orig_size = meta["orig_size"]
+        obj.axis_size = meta["axis_size"]
+        obj.part_size = meta["part_size"]
+        obj.local_data = jnp.concatenate([jnp.ravel(p) for p in parts_list])
+        return obj
+
+    def data(self):
+        return self.local_data
+
+    def full(self, gathered_parts=None):
+        """Reassemble; outside jit the caller provides all parts."""
+        import jax.numpy as jnp
+
+        if gathered_parts is None:
+            gathered_parts = [self.local_data]
+            assert self.axis_size == 1
+        flat = jnp.concatenate([jnp.ravel(p) for p in gathered_parts])
+        return flat[:self.orig_size].reshape(self.orig_shape)
+
+
+def global_norm_from_tree(grads, ord=2):
+    """L2 norm over a pytree of arrays (the reference computes this per
+    partition with cross-group allreduce; under GSPMD psum is implicit)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.zeros(())
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+
+
+def has_overflow(grads):
+    """True if any grad contains inf/nan (reference CheckOverflow, utils.py:63-133).
+    Under pjit the reduction is global automatically."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(grads)
+    finite = jnp.asarray(True)
+    for g in leaves:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+    return jnp.logical_not(finite)
+
+
+def clip_grad_by_global_norm(grads, max_norm, global_norm=None):
+    import jax
+    import jax.numpy as jnp
+
+    if global_norm is None:
+        global_norm = global_norm_from_tree(grads)
+    scale = jnp.minimum(1.0, max_norm / (global_norm + 1e-6))
+    return jax.tree_util.tree_map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
+                                  grads), global_norm
+
+
+def see_memory_usage(message, force=False):
+    if not force:
+        return
+    import jax
+
+    lines = [message]
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        if stats:
+            lines.append(
+                f"  {d}: in_use={stats.get('bytes_in_use', 0)/2**30:.2f}GB "
+                f"peak={stats.get('peak_bytes_in_use', 0)/2**30:.2f}GB "
+                f"limit={stats.get('bytes_limit', 0)/2**30:.2f}GB")
+    logger.info("\n".join(lines))

@@ -1,0 +1,87 @@
+"""Typed ZeRO config object (reference: deepspeed/runtime/zero/config.py:1-106)."""
+from deepspeed_tpu.runtime.config_utils import get_scalar_param
+from deepspeed_tpu.runtime.zero.constants import (
+    MAX_STAGE_ZERO_OPTIMIZATION, ZERO_OPTIMIZATION,
+    ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE,
+    ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEFAULT,
+    ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED,
+    ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS,
+    ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS_DEFAULT,
+    ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS,
+    ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS_DEFAULT,
+    ZERO_OPTIMIZATION_CPU_OFFLOAD, ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT,
+    ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT,
+    ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT,
+    ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS,
+    ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS_DEFAULT,
+    ZERO_OPTIMIZATION_OVERLAP_COMM, ZERO_OPTIMIZATION_OVERLAP_COMM_DEFAULT,
+    ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE,
+    ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE_DEFAULT,
+    ZERO_OPTIMIZATION_REDUCE_SCATTER,
+    ZERO_OPTIMIZATION_REDUCE_SCATTER_DEFAULT, ZERO_OPTIMIZATION_STAGE,
+    ZERO_OPTIMIZATION_STAGE_DEFAULT)
+
+
+class DeepSpeedZeroConfig:
+    def __init__(self, param_dict):
+        self.stage = None
+        self.contiguous_gradients = None
+        self.reduce_scatter = None
+        self.reduce_bucket_size = None
+        self.allgather_partitions = None
+        self.allgather_bucket_size = None
+        self.overlap_comm = None
+        self.cpu_offload = None
+        self.elastic_checkpoint = None
+        self.load_from_fp32_weights = None
+
+        if ZERO_OPTIMIZATION in param_dict:
+            zero_config_dict = param_dict[ZERO_OPTIMIZATION]
+            if isinstance(zero_config_dict, bool):
+                # legacy: "zero_optimization": true  => stage 1
+                zero_config_dict = {ZERO_OPTIMIZATION_STAGE: 1 if zero_config_dict else 0}
+        else:
+            zero_config_dict = {}
+        self._initialize(zero_config_dict)
+
+    def _initialize(self, d):
+        self.stage = get_scalar_param(d, ZERO_OPTIMIZATION_STAGE, ZERO_OPTIMIZATION_STAGE_DEFAULT)
+        assert self.stage <= MAX_STAGE_ZERO_OPTIMIZATION, (
+            f"ZeRO stage {self.stage} not supported; max is {MAX_STAGE_ZERO_OPTIMIZATION} "
+            f"(parity with reference snapshot, engine.py:720-722)")
+        self.contiguous_gradients = get_scalar_param(
+            d, ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS, ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS_DEFAULT)
+        self.reduce_bucket_size = get_scalar_param(
+            d, ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE, ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE_DEFAULT)
+        self.reduce_scatter = get_scalar_param(
+            d, ZERO_OPTIMIZATION_REDUCE_SCATTER, ZERO_OPTIMIZATION_REDUCE_SCATTER_DEFAULT)
+        self.overlap_comm = get_scalar_param(
+            d, ZERO_OPTIMIZATION_OVERLAP_COMM, ZERO_OPTIMIZATION_OVERLAP_COMM_DEFAULT)
+        self.allgather_partitions = get_scalar_param(
+            d, ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS, ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS_DEFAULT)
+        if ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED in d:
+            self.allgather_bucket_size = d[ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED]
+        else:
+            self.allgather_bucket_size = get_scalar_param(
+                d, ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE, ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEFAULT)
+        self.cpu_offload = get_scalar_param(
+            d, ZERO_OPTIMIZATION_CPU_OFFLOAD, ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT)
+        self.elastic_checkpoint = get_scalar_param(
+            d, ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT, ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT)
+        self.load_from_fp32_weights = get_scalar_param(
+            d, ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS, ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS_DEFAULT)
+
+    def repr(self):
+        return dict(stage=self.stage,
+                    contiguous_gradients=self.contiguous_gradients,
+                    reduce_scatter=self.reduce_scatter,
+                    reduce_bucket_size=self.reduce_bucket_size,
+                    allgather_partitions=self.allgather_partitions,
+                    allgather_bucket_size=self.allgather_bucket_size,
+                    overlap_comm=self.overlap_comm,
+                    cpu_offload=self.cpu_offload,
+                    elastic_checkpoint=self.elastic_checkpoint,
+                    load_from_fp32_weights=self.load_from_fp32_weights)
+
+    def __repr__(self):
+        return str(self.repr())

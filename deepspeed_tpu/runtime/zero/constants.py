@@ -1,0 +1,62 @@
+"""ZeRO config key names/defaults (reference: deepspeed/runtime/zero/constants.py).
+
+Format:
+  "zero_optimization": {
+    "stage": [0|1|2],
+    "allgather_partitions": true,
+    "allgather_bucket_size": 500000000,
+    "reduce_scatter": true,
+    "reduce_bucket_size": 500000000,
+    "overlap_comm": false,
+    "contiguous_gradients": true,
+    "cpu_offload": false,
+    "elastic_checkpoint": true,
+    "load_from_fp32_weights": true
+  }
+
+On TPU the bucket sizes and overlap/contiguous flags are accepted for config
+compatibility but are advisory: XLA's SPMD partitioner and latency-hiding
+scheduler own comm bucketing/overlap.  ``stage`` and ``cpu_offload`` change real
+behavior (state sharding spec / host-resident optimizer).
+"""
+
+ZERO_OPTIMIZATION = "zero_optimization"
+
+ZERO_OPTIMIZATION_DISABLED = 0
+ZERO_OPTIMIZATION_OPTIMIZER_STATES = 1
+ZERO_OPTIMIZATION_GRADIENTS = 2
+ZERO_OPTIMIZATION_WEIGHTS = 3          # not implemented in reference snapshot
+MAX_STAGE_ZERO_OPTIMIZATION = ZERO_OPTIMIZATION_GRADIENTS
+
+ZERO_OPTIMIZATION_STAGE = "stage"
+ZERO_OPTIMIZATION_STAGE_DEFAULT = ZERO_OPTIMIZATION_DISABLED
+
+ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS = "allgather_partitions"
+ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS_DEFAULT = True
+
+ZERO_OPTIMIZATION_REDUCE_SCATTER = "reduce_scatter"
+ZERO_OPTIMIZATION_REDUCE_SCATTER_DEFAULT = True
+
+ZERO_OPTIMIZATION_OVERLAP_COMM = "overlap_comm"
+ZERO_OPTIMIZATION_OVERLAP_COMM_DEFAULT = False
+
+ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS = "contiguous_gradients"
+ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS_DEFAULT = True
+
+ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE = "reduce_bucket_size"
+ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE_DEFAULT = 500000000
+
+ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE = "allgather_bucket_size"
+ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEFAULT = 500000000
+ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED = "allgather_size"
+
+ZERO_OPTIMIZATION_CPU_OFFLOAD = "cpu_offload"
+ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT = False
+
+ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT = "elastic_checkpoint"
+ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT = True
+
+ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS = "load_from_fp32_weights"
+ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS_DEFAULT = True
+
+ZERO_OPTIMIZATION_DEFAULT = ZERO_OPTIMIZATION_DISABLED

@@ -1,0 +1,46 @@
+"""Central logging for deepspeed_tpu.
+
+Mirrors the reference logger surface (reference: deepspeed/utils/logging.py:1-60):
+a module-level ``logger`` plus ``log_dist(message, ranks)`` that only emits on the
+listed process ranks (-1 = all).  On TPU the "rank" is the JAX process index.
+"""
+import logging
+import os
+import sys
+
+LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"
+
+
+def _create_logger(name: str = "deepspeed_tpu", level=logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    if getattr(lg, "_ds_tpu_configured", False):
+        return lg
+    lg.setLevel(level)
+    lg.propagate = False
+    handler = logging.StreamHandler(stream=sys.stdout)
+    handler.setFormatter(logging.Formatter(LOG_FORMAT))
+    lg.addHandler(handler)
+    lg._ds_tpu_configured = True
+    return lg
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    # Avoid importing jax at module import time for cheap CLI paths.
+    if "jax" in sys.modules:
+        import jax
+
+        try:
+            return jax.process_index()
+        except RuntimeError:
+            return 0
+    return int(os.environ.get("JAX_PROCESS_INDEX", os.environ.get("RANK", "0")))
+
+
+def log_dist(message: str, ranks=None, level=logging.INFO) -> None:
+    """Log ``message`` only on the given process ranks (None/[-1] => all)."""
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")

@@ -1,0 +1,5 @@
+__version__ = "0.1.0"
+# parity target: reference DeepSpeed snapshot 0.3.11 (version.txt:1)
+__reference_version__ = "0.3.11"
+git_hash = None
+git_branch = None

@@ -1,0 +1,132 @@
+"""Batch-size triangulation + config parsing tests.
+
+Mirrors reference tests/unit/test_config.py + test_ds_config.py behavior.
+"""
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def make_config(d, world_size=1):
+    return DeepSpeedConfig(d, world_size=world_size)
+
+
+# batch-size triangulation: (train_batch, micro_batch, gas) cases
+@pytest.mark.parametrize("num_ranks,batch,micro_batch,gas,success", [
+    (2, 32, 16, 1, True),
+    (2, 32, 8, 2, True),
+    (2, 33, 17, 2, False),
+    (2, 32, 18, 1, False),
+])
+def test_batch_config(num_ranks, batch, micro_batch, gas, success):
+    ds_config = {
+        "train_batch_size": batch,
+        "train_micro_batch_size_per_gpu": micro_batch,
+        "gradient_accumulation_steps": gas,
+    }
+    if success:
+        config = make_config(ds_config, world_size=num_ranks)
+        assert config.train_batch_size == batch
+        assert config.train_micro_batch_size_per_gpu == micro_batch
+        assert config.gradient_accumulation_steps == gas
+    else:
+        with pytest.raises(AssertionError):
+            make_config(ds_config, world_size=num_ranks)
+
+
+def test_two_given_derive_gas():
+    config = make_config({"train_batch_size": 32,
+                          "train_micro_batch_size_per_gpu": 4}, world_size=2)
+    assert config.gradient_accumulation_steps == 4
+
+
+def test_two_given_derive_micro():
+    config = make_config({"train_batch_size": 32,
+                          "gradient_accumulation_steps": 4}, world_size=2)
+    assert config.train_micro_batch_size_per_gpu == 4
+
+
+def test_two_given_derive_train_batch():
+    config = make_config({"train_micro_batch_size_per_gpu": 4,
+                          "gradient_accumulation_steps": 4}, world_size=2)
+    assert config.train_batch_size == 32
+
+
+def test_only_train_batch():
+    config = make_config({"train_batch_size": 32}, world_size=4)
+    assert config.train_micro_batch_size_per_gpu == 8
+    assert config.gradient_accumulation_steps == 1
+
+
+def test_only_micro_batch():
+    config = make_config({"train_micro_batch_size_per_gpu": 4}, world_size=4)
+    assert config.train_batch_size == 16
+    assert config.gradient_accumulation_steps == 1
+
+
+def test_none_given_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        make_config({}, world_size=1)
+
+
+def test_gas_only_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        make_config({"gradient_accumulation_steps": 4}, world_size=1)
+
+
+def test_duplicate_json_keys(tmp_path):
+    cfg = tmp_path / "ds_config.json"
+    cfg.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(cfg), world_size=1)
+
+
+def test_fp16_and_zero_parsing():
+    config = make_config({
+        "train_batch_size": 8,
+        "fp16": {"enabled": True, "loss_scale": 0, "initial_scale_power": 16,
+                 "loss_scale_window": 500, "hysteresis": 2, "min_loss_scale": 1},
+        "zero_optimization": {"stage": 2, "cpu_offload": False,
+                              "reduce_bucket_size": 1000000},
+        "gradient_clipping": 1.0,
+    }, world_size=1)
+    assert config.fp16_enabled
+    assert config.initial_dynamic_scale == 2 ** 16
+    assert config.dynamic_loss_scale_args["scale_window"] == 500
+    assert config.zero_enabled
+    assert config.zero_optimization_stage == 2
+    assert config.zero_config.reduce_bucket_size == 1000000
+    assert config.gradient_clipping == 1.0
+
+
+def test_zero_stage3_rejected():
+    with pytest.raises(AssertionError):
+        make_config({"train_batch_size": 8, "zero_optimization": {"stage": 3}})
+
+
+def test_legacy_zero_bool():
+    config = make_config({"train_batch_size": 8, "zero_optimization": True})
+    assert config.zero_enabled
+    assert config.zero_optimization_stage == 1
+
+
+def test_optimizer_scheduler_parsing():
+    config = make_config({
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.001, "betas": [0.9, 0.999]}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_min_lr": 0, "warmup_max_lr": 0.001,
+                                 "warmup_num_steps": 10}},
+    })
+    assert config.optimizer_name == "adam"
+    assert config.optimizer_params["lr"] == 0.001
+    assert config.scheduler_name == "WarmupLR"
+    assert config.scheduler_params["warmup_num_steps"] == 10
+
+
+def test_pld_parsing():
+    config = make_config({"train_batch_size": 8,
+                          "progressive_layer_drop": {"enabled": True, "gamma": 0.01}})
+    assert config.pld_enabled
+    assert config.pld_gamma == 0.01
+    assert config.pld_theta == 1.0

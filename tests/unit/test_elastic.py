@@ -1,0 +1,113 @@
+"""Elasticity arithmetic tests (mirrors reference tests/unit/test_elastic.py)."""
+import pytest
+
+from deepspeed_tpu.elasticity import compute_elastic_config, get_valid_gpus
+from deepspeed_tpu.elasticity.config import (ElasticityConfigError,
+                                             ElasticityIncompatibleWorldSize)
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+base_ds_config = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def test_basic_10k():
+    ds_config = {k: dict(v) for k, v in base_ds_config.items()}
+    final_batch_size, valid_gpus = compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version="0.3.11")
+    for gpu_num in valid_gpus:
+        assert final_batch_size % gpu_num == 0
+        batch_per_gpu = final_batch_size // gpu_num
+        found_valid_mbsize = any(
+            batch_per_gpu % mb == 0
+            for mb in ds_config["elasticity"]["micro_batch_sizes"])
+        assert found_valid_mbsize, f"No valid mb size for gpu count {gpu_num}"
+
+
+def test_valid_world_size():
+    ds_config = {k: dict(v) for k, v in base_ds_config.items()}
+    final_batch_size, valid_gpus, mbsize = compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version="0.3.11", world_size=64)
+    assert 64 in valid_gpus
+    assert final_batch_size % (mbsize * 64) == 0
+
+
+def test_invalid_world_size():
+    ds_config = {k: dict(v) for k, v in base_ds_config.items()}
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(ds_config=ds_config,
+                               target_deepspeed_version="0.3.11", world_size=128)
+
+
+def test_future_elastic_version():
+    ds_config = {k: dict(v) for k, v in base_ds_config.items()}
+    ds_config["elasticity"]["version"] = 0.2
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config=ds_config, target_deepspeed_version="0.3.11")
+
+
+def test_missing_max_batch():
+    ds_config = {"elasticity": {"enabled": True, "micro_batch_sizes": [2, 4]}}
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config=ds_config, target_deepspeed_version="0.3.11")
+
+
+def test_missing_micro_batch():
+    ds_config = {"elasticity": {"enabled": True, "max_train_batch_size": 4}}
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config=ds_config, target_deepspeed_version="0.3.11")
+
+
+def test_non_elastic_batch_params_rejected():
+    ds_config = {
+        "train_batch_size": 4,
+        "elasticity": {
+            "enabled": True, "max_train_batch_size": 4, "micro_batch_sizes": [1, 2, 3, 4],
+            "min_gpus": 1, "max_gpus": 4, "min_time": 20, "version": 0.1,
+        },
+    }
+    with pytest.raises(ElasticityConfigError):
+        DeepSpeedConfig(ds_config, world_size=1)
+
+
+def test_non_elastic_batch_params_w_override():
+    ds_config = {
+        "train_batch_size": 4,
+        "elasticity": {
+            "enabled": True, "max_train_batch_size": 4, "micro_batch_sizes": [1, 2, 3, 4],
+            "min_gpus": 1, "max_gpus": 4, "min_time": 20, "version": 0.1,
+            "ignore_non_elastic_batch_info": True,
+        },
+    }
+    config = DeepSpeedConfig(ds_config, world_size=1)
+    assert config.elasticity_enabled
+
+
+def test_proper_mbsz():
+    # same scenario as the reference test: expects micro-batch 3 at world size 7
+    ds_config = {
+        "elasticity": {
+            "enabled": True, "max_train_batch_size": 32, "micro_batch_sizes": [1, 2, 3, 7],
+            "min_gpus": 1, "max_gpus": 1500, "min_time": 20, "version": 0.1,
+        },
+    }
+    final_batch_size, valid_gpus, mbsize = compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version="0.3.11", world_size=7)
+    assert mbsize == 3
+    assert (final_batch_size // 7) % mbsize == 0
+
+
+def test_get_valid_gpus():
+    valid = get_valid_gpus(batch_size=24, micro_batches=[2, 3], min_valid_gpus=1,
+                           max_valid_gpus=24)
+    # world w valid iff 24/(mb) divisible by w for mb in {2,3}: 12's divisors + 8's divisors
+    expected = sorted(set([1, 2, 3, 4, 6, 12]) | set([1, 2, 4, 8]))
+    assert valid == expected

@@ -1,0 +1,99 @@
+"""LR scheduler behavior tests (mirrors reference tests/unit/test_lr_schedulers.py)."""
+import math
+
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (LRRangeTest, OneCycle, WarmupDecayLR,
+                                                WarmupLR)
+
+
+def test_warmup_lr_monotonic_then_flat():
+    sched = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10)
+    lrs = [sched.step() for _ in range(20)]
+    # non-decreasing during warmup
+    for a, b in zip(lrs[:10], lrs[1:11]):
+        assert b >= a
+    # flat after warmup
+    for lr in lrs[10:]:
+        assert lr == pytest.approx(0.1)
+
+
+def test_warmup_lr_log_shape():
+    sched = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=100)
+    assert sched.lr_at(0) == pytest.approx(math.log(1) / math.log(100))
+    assert sched.lr_at(99) == pytest.approx(math.log(100) / math.log(100))
+
+
+def test_warmup_decay_lr():
+    sched = WarmupDecayLR(total_num_steps=20, warmup_min_lr=0.0, warmup_max_lr=0.1,
+                          warmup_num_steps=10)
+    lrs = [sched.step() for _ in range(25)]
+    assert lrs[9] == pytest.approx(0.1)
+    # linear decay to zero
+    for a, b in zip(lrs[10:20], lrs[11:21]):
+        assert b <= a
+    assert lrs[20] == pytest.approx(0.0)
+    assert lrs[24] == pytest.approx(0.0)  # clamped at 0 past the end
+
+
+def test_lr_range_test_continuous():
+    sched = LRRangeTest(lr_range_test_min_lr=0.01, lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0, lr_range_test_staircase=False)
+    assert sched.lr_at(0) == pytest.approx(0.01)
+    assert sched.lr_at(10) == pytest.approx(0.02)
+    assert sched.lr_at(20) == pytest.approx(0.03)
+
+
+def test_lr_range_test_staircase():
+    sched = LRRangeTest(lr_range_test_min_lr=0.01, lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0, lr_range_test_staircase=True)
+    for s in range(10):
+        assert sched.lr_at(s) == pytest.approx(0.01)
+    for s in range(10, 20):
+        assert sched.lr_at(s) == pytest.approx(0.02)
+
+
+def test_lr_range_test_invalid_min_lr():
+    with pytest.raises(ValueError):
+        LRRangeTest(lr_range_test_min_lr=0.0)
+
+
+def test_one_cycle_triangle():
+    sched = OneCycle(cycle_min_lr=0.001, cycle_max_lr=0.01,
+                     cycle_first_step_size=10, cycle_second_step_size=10,
+                     decay_lr_rate=0.0)
+    assert sched.lr_at(0) == pytest.approx(0.001)
+    assert sched.lr_at(10) == pytest.approx(0.01)
+    assert sched.lr_at(20) == pytest.approx(0.001)
+    # peak is the max
+    lrs = [sched.lr_at(s) for s in range(21)]
+    assert max(lrs) == pytest.approx(0.01)
+    assert lrs.index(max(lrs)) == 10
+
+
+def test_one_cycle_decay_phase():
+    sched = OneCycle(cycle_min_lr=0.001, cycle_max_lr=0.01,
+                     cycle_first_step_size=5, cycle_second_step_size=5,
+                     decay_step_size=1, decay_lr_rate=0.5)
+    lr_after = sched.lr_at(12)  # 2 decay steps past cycle end (10)
+    assert lr_after == pytest.approx(0.001 / (1 + 2 * 0.5))
+
+
+def test_one_cycle_momentum_inverse():
+    sched = OneCycle(cycle_min_lr=0.001, cycle_max_lr=0.01,
+                     cycle_first_step_size=10, cycle_second_step_size=10,
+                     cycle_min_mom=0.85, cycle_max_mom=0.95)
+    assert sched.mom_at(0) == pytest.approx(0.95)
+    assert sched.mom_at(10) == pytest.approx(0.85)
+    assert sched.mom_at(20) == pytest.approx(0.95)
+
+
+def test_state_dict_roundtrip():
+    sched = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10)
+    for _ in range(5):
+        sched.step()
+    sd = sched.state_dict()
+    sched2 = WarmupLR(warmup_max_lr=0.1, warmup_num_steps=10)
+    sched2.load_state_dict(sd)
+    assert sched2.last_batch_iteration == sched.last_batch_iteration
+    assert sched2.step() == sched.step()

@@ -1,0 +1,71 @@
+"""Partitioning utils tests (mirrors reference tests/unit/test_partition.py)."""
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.utils import (PartitionedTensor, partition_balanced,
+                                         partition_uniform, prefix_sum_inc)
+
+
+def test_prefix_sum():
+    assert prefix_sum_inc([1, 2, 3]) == [1, 3, 6]
+
+
+def test_partition_uniform_even():
+    parts = partition_uniform(8, 4)
+    assert parts == [0, 2, 4, 6, 8]
+
+
+def test_partition_uniform_residual():
+    parts = partition_uniform(10, 4)
+    assert parts[0] == 0 and parts[-1] == 10
+    sizes = [parts[i + 1] - parts[i] for i in range(4)]
+    assert sorted(sizes) == [2, 2, 3, 3]
+
+
+def test_partition_uniform_fewer_items():
+    parts = partition_uniform(2, 4)
+    assert parts[0] == 0 and parts[-1] == 2
+    sizes = [parts[i + 1] - parts[i] for i in range(4)]
+    assert sum(sizes) == 2 and max(sizes) <= 1
+
+
+def test_partition_balanced_uniform_weights():
+    parts = partition_balanced([1] * 8, 4)
+    assert parts == [0, 2, 4, 6, 8]
+
+
+def test_partition_balanced_skewed():
+    weights = [1, 1, 1, 1, 10]
+    parts = partition_balanced(weights, 2)
+    # heavy item should be alone-ish: bottleneck minimized
+    sizes = [sum(weights[parts[i]:parts[i + 1]]) for i in range(2)]
+    assert max(sizes) == 10
+
+
+def test_partition_balanced_monotone_boundaries():
+    weights = list(np.random.RandomState(0).randint(1, 10, size=20))
+    parts = partition_balanced(weights, 4)
+    assert parts[0] == 0 and parts[-1] == 20
+    assert all(parts[i] <= parts[i + 1] for i in range(4))
+
+
+def test_partitioned_tensor_roundtrip():
+    import jax.numpy as jnp
+
+    x = jnp.arange(20, dtype=jnp.float32).reshape(4, 5)
+    world = 4
+    parts = [PartitionedTensor(x, world, r) for r in range(world)]
+    meta = parts[0].to_meta()
+    assert meta["part_size"] * world >= 20
+    full = parts[0].full([p.data() for p in parts])
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(x))
+
+
+def test_partitioned_tensor_uneven():
+    import jax.numpy as jnp
+
+    x = jnp.arange(7, dtype=jnp.float32)
+    world = 4
+    parts = [PartitionedTensor(x, world, r) for r in range(world)]
+    full = parts[0].full([p.data() for p in parts])
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(x))

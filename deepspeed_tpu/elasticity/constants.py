@@ -44,4 +44,5 @@ VERSION = "version"
 VERSION_DEFAULT = 0.1
 
 LATEST_ELASTICITY_VERSION = 0.1
-MINIMUM_DEEPSPEED_VERSION = "0.3.8"
+# minimum framework version supporting elasticity (reference analog: 0.3.8)
+MINIMUM_DEEPSPEED_VERSION = "0.1.0"

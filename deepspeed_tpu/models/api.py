@@ -1,0 +1,72 @@
+"""Model contract consumed by the engine.
+
+The reference engine wraps a torch ``nn.Module`` (reference: runtime/engine.py:101).
+The TPU engine is functional: a model is anything exposing
+
+  - ``init(rng, batch) -> params``                (parameter pytree, fp32)
+  - ``loss(params, batch, rng, train) -> (loss, metrics_dict)``
+  - ``param_partition_spec(params) -> pytree of PartitionSpec``  (optional;
+    tensor-parallel layout over the 'model' mesh axis — this build implements
+    TP natively, unlike the reference which delegates to an external Megatron
+    mpu, SURVEY §2.5)
+
+``FlaxModel`` adapts a flax linen module + loss head to this contract.
+"""
+from typing import Any, Callable, Optional
+
+
+class FlaxModel:
+    """Adapter: flax linen module -> engine model contract.
+
+    module.__call__(batch_inputs, train=...) must return model outputs;
+    ``loss_head(outputs, batch) -> (scalar_loss, metrics)``.
+    """
+
+    def __init__(self, module, loss_head: Callable, input_key: str = "input",
+                 partition_rules: Optional[Callable] = None):
+        self.module = module
+        self.loss_head = loss_head
+        self.input_key = input_key
+        self.partition_rules = partition_rules
+
+    def init(self, rng, batch):
+        variables = self.module.init(
+            {"params": rng, "dropout": rng}, batch[self.input_key], train=False)
+        return variables["params"]
+
+    def loss(self, params, batch, rng, train=True):
+        outputs = self.module.apply({"params": params}, batch[self.input_key],
+                                    train=train, rngs={"dropout": rng})
+        return self.loss_head(outputs, batch)
+
+    def param_partition_spec(self, params):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        if self.partition_rules is None:
+            return jax.tree_util.tree_map(lambda _: P(), params)
+        return self.partition_rules(params)
+
+
+def replicated_spec(params):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree_util.tree_map(lambda _: P(), params)
+
+
+def cross_entropy_loss(logits, labels, ignore_index: Optional[int] = None):
+    """Token-level softmax cross entropy; returns (mean_loss, metrics)."""
+    import jax.numpy as jnp
+
+    logits = logits.astype(jnp.float32)
+    logz = jnp.log(jnp.sum(jnp.exp(logits - jnp.max(logits, -1, keepdims=True)),
+                           -1)) + jnp.max(logits, -1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    if ignore_index is not None:
+        mask = (labels != ignore_index).astype(jnp.float32)
+        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    else:
+        loss = jnp.mean(nll)
+    return loss, {"loss": loss}

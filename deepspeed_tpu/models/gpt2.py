@@ -1,0 +1,190 @@
+"""GPT-2 family — the flagship LM for benchmarks.
+
+TPU-first design: flax linen decoder with
+- bf16 compute / fp32 master params (engine-managed),
+- Megatron-style tensor parallelism expressed as PartitionSpecs over the
+  'model' mesh axis (this build owns TP natively; the reference only consumed
+  an external Megatron mpu, SURVEY §2.5),
+- jax.checkpoint (remat) per block for activation checkpointing,
+- attention through ops.transformer.functional (Pallas flash path on TPU).
+
+Size table mirrors the reference perf harness configs
+(tests/model/Megatron_GPT2/run_perf_test.py:18-84: 1.5B = 48L x 1600h etc.).
+"""
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.models.api import cross_entropy_loss
+from deepspeed_tpu.ops.transformer.functional import scaled_dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16      # compute dtype
+    remat: bool = False            # activation checkpointing per block
+    use_pallas_attention: Optional[bool] = None  # None = auto
+
+    @property
+    def head_dim(self):
+        return self.n_embd // self.n_head
+
+
+# named configs; 1.5B mirrors the reference's 48L/1600h perf config
+GPT2_SIZES = {
+    "gpt2-125m": dict(n_layer=12, n_embd=768, n_head=12),
+    "gpt2-350m": dict(n_layer=24, n_embd=1024, n_head=16),
+    "gpt2-760m": dict(n_layer=24, n_embd=1536, n_head=16),
+    "gpt2-1.5b": dict(n_layer=48, n_embd=1600, n_head=25),
+    "gpt2-4b": dict(n_layer=64, n_embd=2304, n_head=24),
+    "gpt2-8b": dict(n_layer=72, n_embd=3072, n_head=24),
+    "gpt2-10b": dict(n_layer=50, n_embd=4096, n_head=32),
+}
+
+
+def gpt2_config(name: str, **overrides) -> GPT2Config:
+    base = dict(GPT2_SIZES[name])
+    base.update(overrides)
+    return GPT2Config(**base)
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.config
+        B, S, E = x.shape
+        # fused QKV projection: one big MXU matmul, sharded over 'model'
+        qkv = nn.Dense(3 * E, dtype=cfg.dtype, name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, S, cfg.n_head, cfg.head_dim).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        drop_rng = self.make_rng("dropout") if (train and cfg.dropout > 0) else None
+        y = scaled_dot_product_attention(
+            q, k, v, causal=True, dropout_rng=drop_rng,
+            dropout_rate=cfg.dropout if train else 0.0,
+            use_pallas=cfg.use_pallas_attention)
+        y = y.transpose(0, 2, 1, 3).reshape(B, S, E)
+        y = nn.Dense(E, dtype=cfg.dtype, name="c_proj")(y)
+        if train and cfg.dropout > 0:
+            y = nn.Dropout(cfg.dropout)(y, deterministic=False)
+        return y
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.config
+        h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, name="c_fc")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="c_proj")(h)
+        if train and cfg.dropout > 0:
+            h = nn.Dropout(cfg.dropout)(h, deterministic=False)
+        return h
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.config
+        # pre-LN
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         name="ln_1")(x), train)
+        x = x + MLP(cfg, name="mlp")(
+            nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         name="ln_2")(x), train)
+        # keep activations sharded batch-over-data as blocks stack
+        x = jax.lax.with_sharding_constraint(x, P("data", None, None))
+        return x
+
+
+class GPT2LMHead(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = False):
+        cfg = self.config
+        B, S = input_ids.shape
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.n_embd), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (cfg.n_positions, cfg.n_embd), jnp.float32)
+        x = wte.astype(cfg.dtype)[input_ids] + wpe.astype(cfg.dtype)[None, :S]
+        if train and cfg.dropout > 0:
+            x = nn.Dropout(cfg.dropout)(x, deterministic=False)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=(2,))
+        for i in range(cfg.n_layer):
+            x = block(cfg, name=f"h_{i}")(x, train)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         name="ln_f")(x)
+        # tied LM head: logits against the embedding matrix
+        logits = jnp.einsum("bse,ve->bsv", x, wte.astype(cfg.dtype))
+        return logits
+
+
+class GPT2Model:
+    """Engine model contract for GPT-2 (see models/api.py)."""
+
+    def __init__(self, config: GPT2Config):
+        self.config = config
+        self.module = GPT2LMHead(config)
+
+    def init(self, rng, batch):
+        return self.module.init({"params": rng, "dropout": rng},
+                                batch["input_ids"], train=False)["params"]
+
+    def loss(self, params, batch, rng, train=True):
+        logits = self.module.apply({"params": params}, batch["input_ids"],
+                                   train=train, rngs={"dropout": rng})
+        # next-token LM loss
+        return cross_entropy_loss(logits[:, :-1], batch["labels"][:, 1:],
+                                  ignore_index=-100)
+
+    def param_partition_spec(self, params):
+        """Megatron-style TP layout over the 'model' axis:
+        - QKV and MLP-in kernels: shard output dim,
+        - attn-out and MLP-out kernels: shard input dim,
+        - token embedding: shard vocab dim,
+        - LayerNorms/biases on sharded-output layers: shard to match.
+        """
+        def spec(path, leaf):
+            names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
+            joined = "/".join(str(n) for n in names)
+            if leaf.ndim == 0:
+                return P()
+            if "wte" in joined:
+                return P("model", None)
+            if "wpe" in joined:
+                return P()
+            if "c_attn" in joined or "c_fc" in joined:
+                return P(None, "model") if leaf.ndim == 2 else P("model")
+            if "c_proj" in joined:
+                return P("model", None) if leaf.ndim == 2 else P()
+            return P()
+
+        return jax.tree_util.tree_map_with_path(spec, params)
+
+    def num_params(self, params):
+        return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))

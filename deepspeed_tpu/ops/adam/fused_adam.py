@@ -1,0 +1,100 @@
+"""Fused Adam/AdamW for TPU.
+
+Reference: csrc/adam/multi_tensor_adam.cu + ops/adam/fused_adam.py:15-182 —
+an apex-style multi-tensor-apply chunked kernel.  On TPU the same fusion falls
+out of XLA: the whole pytree update compiles into fused HBM-bandwidth-bound
+loops inside the jitted train step, so the "kernel" is pure jnp (SURVEY §2.7).
+
+Like the reference kernel, ``update`` takes an optional gradient ``scale`` so
+fp16 unscaling fuses into the update (reference fused_adam.py `step(scale=...)`).
+"""
+from typing import NamedTuple
+
+_ADAM_MODE_ADAMW = 0  # decoupled weight decay
+_ADAM_MODE_L2 = 1     # L2 regularization added to grad
+
+
+class AdamState(NamedTuple):
+    step: object  # i32
+    m: object     # pytree, fp32
+    v: object     # pytree, fp32
+
+
+class FusedAdam:
+    """Adam/AdamW over fp32 master params; grads may be fp16/bf16 (cast in)."""
+
+    name = "adam"
+
+    def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
+                 eps=1e-8, adam_w_mode=True, weight_decay=0.0, amsgrad=False):
+        assert not amsgrad, "amsgrad not supported (parity with reference fused_adam.py:61)"
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.weight_decay = weight_decay
+
+    def init_state(self, master_params) -> AdamState:
+        import jax
+        import jax.numpy as jnp
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), master_params)
+        return AdamState(step=jnp.int32(0), m=zeros,
+                         v=jax.tree_util.tree_map(jnp.copy, zeros))
+
+    def update(self, grads, state: AdamState, master_params, lr=None, scale=1.0):
+        """One fused step.  Returns (new_master_params, new_state).
+
+        grads are divided by ``scale`` (fused unscale), cast to fp32.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+        b1, b2 = self.beta1, self.beta2
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** step.astype(jnp.float32)
+            bc2 = 1.0 - b2 ** step.astype(jnp.float32)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        inv_scale = 1.0 / scale
+
+        def leaf(g, m, v, p):
+            g = g.astype(jnp.float32) * inv_scale
+            if not self.adam_w_mode and self.weight_decay > 0:
+                g = g + self.weight_decay * p
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * jnp.square(g)
+            update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)
+            if self.adam_w_mode and self.weight_decay > 0:
+                update = update + self.weight_decay * p
+            return p - lr * update, m_new, v_new
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_m = jax.tree_util.tree_leaves(state.m)
+        flat_v = jax.tree_util.tree_leaves(state.v)
+        flat_p = jax.tree_util.tree_leaves(master_params)
+        new_p, new_m, new_v = [], [], []
+        for g, m, v, p in zip(flat_g, flat_m, flat_v, flat_p):
+            p2, m2, v2 = leaf(g, m, v, p)
+            new_p.append(p2)
+            new_m.append(m2)
+            new_v.append(v2)
+        unflatten = treedef.unflatten
+        return unflatten(new_p), AdamState(step=step, m=unflatten(new_m),
+                                           v=unflatten(new_v))
+
+    def state_spec(self, param_specs):
+        """Sharding spec for the state, matching the master-param specs."""
+        return AdamState(step=None, m=param_specs, v=param_specs)
+
+
+class FusedAdamW(FusedAdam):
+    name = "adamw"
+
+    def __init__(self, **kw):
+        kw.setdefault("adam_w_mode", True)
+        super().__init__(**kw)

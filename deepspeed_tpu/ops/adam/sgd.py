@@ -1,0 +1,56 @@
+"""Plain SGD with momentum — TPU extension (the reference passes torch.optim.SGD
+through; here it is a first-class fused update)."""
+from typing import NamedTuple
+
+
+class SGDState(NamedTuple):
+    step: object
+    momentum_buf: object
+
+
+class SGD:
+    name = "sgd"
+
+    def __init__(self, lr=1e-3, momentum=0.0, weight_decay=0.0, nesterov=False):
+        self.lr = lr
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+
+    def init_state(self, master_params) -> SGDState:
+        import jax
+        import jax.numpy as jnp
+
+        return SGDState(
+            step=jnp.int32(0),
+            momentum_buf=jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), master_params))
+
+    def update(self, grads, state: SGDState, master_params, lr=None, scale=1.0):
+        import jax
+        import jax.numpy as jnp
+
+        lr = self.lr if lr is None else lr
+        inv = 1.0 / scale
+
+        def leaf(g, buf, p):
+            g = g.astype(jnp.float32) * inv
+            if self.weight_decay > 0:
+                g = g + self.weight_decay * p
+            if self.momentum > 0:
+                buf = self.momentum * buf + g
+                d = g + self.momentum * buf if self.nesterov else buf
+            else:
+                d = g
+            return p - lr * d, buf
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_b = jax.tree_util.tree_leaves(state.momentum_buf)
+        flat_p = jax.tree_util.tree_leaves(master_params)
+        out = [leaf(g, b, p) for g, b, p in zip(flat_g, flat_b, flat_p)]
+        return (treedef.unflatten([o[0] for o in out]),
+                SGDState(step=state.step + 1,
+                         momentum_buf=treedef.unflatten([o[1] for o in out])))
+
+    def state_spec(self, param_specs):
+        return SGDState(step=None, momentum_buf=param_specs)

@@ -1,0 +1,94 @@
+"""Attention + fused-elementwise functional ops: the dispatch point between the
+jnp reference path and Pallas TPU kernels.
+
+Reference analog: csrc/transformer/*.cu fused kernels (SURVEY §2.7).  Every op
+here has a jnp reference implementation (always correct, XLA-fused) and may
+have a Pallas fast path registered; `deepspeed_tpu.ops.registry` reports which
+is active (the ds_report analog).
+"""
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def scaled_dot_product_attention(q, k, v, *, mask=None, bias=None, causal=False,
+                                 dropout_rng=None, dropout_rate=0.0,
+                                 scale: Optional[float] = None,
+                                 use_pallas: Optional[bool] = None):
+    """Attention over [batch, heads, seq, head_dim] tensors.
+
+    jnp reference path; the Pallas flash-attention kernel is dispatched for TPU
+    when shapes allow (see deepspeed_tpu.ops.transformer.flash_attention).
+    """
+    if use_pallas is None:
+        use_pallas = _pallas_attention_ok(q, k, v, mask, bias, dropout_rate)
+    if use_pallas:
+        from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+
+    head_dim = q.shape[-1]
+    scale = (head_dim ** -0.5) if scale is None else scale
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        q_len, k_len = logits.shape[-2], logits.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((q_len, k_len), dtype=bool),
+                               k_len - q_len)
+        logits = jnp.where(causal_mask, logits, jnp.float32(-1e30))
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _pallas_attention_ok(q, k, v, mask, bias, dropout_rate) -> bool:
+    # Pallas path: TPU backend, no arbitrary mask/bias/dropout (causal handled
+    # in-kernel), seq and head_dim aligned to MXU tiles.
+    if mask is not None or bias is not None or dropout_rate > 0.0:
+        return False
+    try:
+        if jax.default_backend() not in ("tpu",):
+            return False
+    except Exception:
+        return False
+    b, h, s, d = q.shape
+    return s % 128 == 0 and d in (64, 128, 256) and k.shape == q.shape
+
+
+def gelu(x, approximate=True):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def bias_gelu(x, bias):
+    """Fused bias+GeLU (reference csrc/transformer/gelu_kernels.cu); XLA fuses."""
+    return jax.nn.gelu(x + bias, approximate=True)
+
+
+def layer_norm(x, gamma, beta, eps=1e-12):
+    """LayerNorm in fp32 accumulations (reference normalize_kernels.cu)."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (y * gamma + beta).astype(x.dtype)
+
+
+def bias_residual_layer_norm(x, bias, residual, gamma, beta, eps=1e-12):
+    """Fused bias+residual+LayerNorm (reference: fused add+LN in
+    normalize_kernels.cu)."""
+    return layer_norm(x + bias + residual, gamma, beta, eps)
+
+
+def dropout(x, rng, rate, deterministic=False):
+    if deterministic or rate == 0.0:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)

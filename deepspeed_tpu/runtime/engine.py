@@ -1,0 +1,918 @@
+"""DeepSpeedEngine — TPU-native training engine.
+
+API parity with the reference engine (reference: deepspeed/runtime/engine.py:101:
+forward :810 / backward :871 / step :1016 / save_checkpoint :1489 /
+load_checkpoint :1299), implemented functionally:
+
+- ONE jitted micro-step (value_and_grad + fp32 grad accumulation) and one
+  jitted apply-step (overflow check -> lax.cond{skip, update} -> loss-scale
+  update), instead of per-parameter backward hooks and bucketed NCCL calls.
+- Parallelism is a named-axis Mesh; data parallelism = batch sharded over
+  'data' (XLA inserts the psum/reduce_scatter the reference does by hand in
+  engine.py:852-868 and zero/stage2.py:740-821).
+- ZeRO-1/2 = sharding specs on master weights / optimizer moments / gradient
+  accumulator over the 'data' axis (see parallel/mesh.py:zero_partition_spec);
+  XLA's SPMD partitioner emits reduce-scatter of grads into the shard and
+  all-gather of updated params — the bucket/stream machinery of stage2.py
+  disappears (SURVEY §7).
+- fp16 master-weight flow: params live in compute dtype (fp16/bf16),
+  fp32 master + moments inside the optimizer state (reference
+  fp16/fused_optimizer.py:17).
+"""
+import os
+import pickle
+import time
+from typing import Any, NamedTuple, Optional
+
+import numpy as np
+
+from deepspeed_tpu.parallel import mesh as mesh_lib
+from deepspeed_tpu.runtime.config import (ADAFACTOR_OPTIMIZER, ADAM_OPTIMIZER,
+                                          ADAMW_OPTIMIZER, DeepSpeedConfig,
+                                          LAMB_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
+                                          SGD_OPTIMIZER)
+from deepspeed_tpu.runtime.constants import ROUTE_TRAIN
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from deepspeed_tpu.runtime.fp16.loss_scaler import (LossScaleState,
+                                                    make_loss_scale_state,
+                                                    update_loss_scale)
+from deepspeed_tpu.runtime.lr_schedules import SCHEDULER_REGISTRY
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+
+MEMORY_OPT_ALLREDUCE_SIZE = 500000000
+
+FORWARD_MICRO_TIMER = "forward_microstep"
+FORWARD_GLOBAL_TIMER = "forward"
+BACKWARD_MICRO_TIMER = "backward_microstep"
+BACKWARD_GLOBAL_TIMER = "backward"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+
+class TrainState(NamedTuple):
+    """Full training state — a single pytree, sharded per config."""
+    step: Any             # i32: optimizer steps taken
+    micro_step: Any       # i32: micro-batches in current accumulation window
+    params: Any           # compute-dtype params (replicated over 'data', TP over 'model')
+    opt_state: Any        # optimizer state incl. fp32 master (ZeRO-sharded)
+    master: Any           # fp32 master params (None in pure-fp32 mode: params are master)
+    accum: Any            # fp32 grad accumulator (ZeRO-2: sharded over 'data')
+    scaler: Any           # LossScaleState or None
+    skipped_steps: Any    # i32
+    rng: Any              # PRNGKey
+
+
+class DeepSpeedEngine:
+    def __init__(self, args=None, model=None, optimizer=None,
+                 model_parameters=None, training_data=None, lr_scheduler=None,
+                 mpu=None, dist_init_required=None, collate_fn=None,
+                 config_params=None, dont_change_device=False):
+        import jax
+
+        assert model is not None, "deepspeed_tpu.initialize requires a model"
+        self.module = model
+        self.client_optimizer = optimizer
+        self.client_lr_scheduler = lr_scheduler
+        self.training_data = training_data
+        self.collate_fn = collate_fn
+        self.mpu = mpu
+        self.global_steps = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self.gradient_average = True
+        self.warn_unscaled_loss = True
+
+        if dist_init_required is None or dist_init_required:
+            from deepspeed_tpu.utils.distributed import init_distributed
+
+            init_distributed()
+
+        # --- config -------------------------------------------------------
+        config_file = getattr(args, "deepspeed_config", None) if args else None
+        if config_file is None and args is not None:
+            config_file = getattr(args, "deepscale_config", None)
+        raw = config_params if config_params is not None else config_file
+        assert raw is not None, \
+            "DeepSpeed requires --deepspeed_config or config_params"
+        if isinstance(raw, str):
+            import json
+
+            from deepspeed_tpu.runtime.config_utils import load_config_json
+
+            raw_dict = load_config_json(raw)
+        else:
+            raw_dict = raw
+
+        # mesh first: the config's world size is the data-parallel degree
+        from deepspeed_tpu.runtime.config import get_mesh_shape
+
+        self.mesh = mesh_lib.build_mesh(get_mesh_shape(raw_dict))
+        self.dp_world_size = mesh_lib.dp_size(self.mesh)
+        self.mp_world_size = mesh_lib.mp_size(self.mesh)
+        self._config = DeepSpeedConfig(raw_dict, world_size=self.dp_world_size)
+        self._config.print_enabled = False
+
+        self.local_dp_size = max(1, self.dp_world_size // jax.process_count())
+
+        # --- precision ----------------------------------------------------
+        import jax.numpy as jnp
+
+        if self.fp16_enabled():
+            self.compute_dtype = jnp.float16
+        elif self.bf16_enabled() or self.amp_enabled():
+            self.compute_dtype = jnp.bfloat16
+        else:
+            self.compute_dtype = jnp.float32
+        self.mixed_precision = self.compute_dtype != jnp.float32
+
+        # --- optimizer / scheduler / misc --------------------------------
+        self.optimizer = self._configure_basic_optimizer()
+        self.lr_scheduler = self._configure_lr_scheduler()
+        self.progressive_layer_drop = None
+        if self.pld_enabled():
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=self.pld_theta(), gamma=self.pld_gamma())
+
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_micro_batch_size_per_gpu() * self.dp_world_size,
+            num_workers=1, steps_per_output=self.steps_per_print())
+
+        self.training_dataloader = self.deepspeed_io(training_data) \
+            if training_data is not None else None
+
+        # --- state (lazy: built on first batch) --------------------------
+        self.state: Optional[TrainState] = None
+        self._state_shardings = None
+        self._jit_micro = None
+        self._jit_apply = None
+        self._jit_fused = None
+        self._jit_eval = None
+        self._pending_state = None
+        self._pending_loss = None
+        self._monitor_file = None
+        if self.tensorboard_enabled() and jax.process_index() == 0:
+            os.makedirs(self.tensorboard_output_path() or ".", exist_ok=True)
+            self._monitor_file = os.path.join(
+                self.tensorboard_output_path() or ".",
+                f"{self.tensorboard_job_name()}.events.jsonl")
+
+        seed = int(raw_dict.get("seed", 42))
+        self._init_rng = jax.random.PRNGKey(seed)
+
+        log_dist(
+            f"DeepSpeedEngine: mesh={dict(self.mesh.shape)} "
+            f"dtype={self.compute_dtype.__name__} zero_stage={self.zero_optimization_stage()} "
+            f"micro_batch={self.train_micro_batch_size_per_gpu()} "
+            f"gas={self.gradient_accumulation_steps()}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    # config getters (parity with reference engine.py:212-406)
+    # ------------------------------------------------------------------
+    def train_batch_size(self):
+        return self._config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self):
+        return self._config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self):
+        return self._config.gradient_accumulation_steps
+
+    def steps_per_print(self):
+        return self._config.steps_per_print
+
+    def fp16_enabled(self):
+        return self._config.fp16_enabled
+
+    def bf16_enabled(self):
+        return self._config.bf16_enabled
+
+    def amp_enabled(self):
+        return self._config.amp_enabled
+
+    def loss_scale(self):
+        if self.state is not None and self.state.scaler is not None:
+            return float(self.state.scaler.loss_scale)
+        return self._config.loss_scale or self._config.initial_dynamic_scale
+
+    def dynamic_loss_scale(self):
+        return self._config.loss_scale == 0
+
+    def gradient_clipping(self):
+        return self._config.gradient_clipping
+
+    def zero_optimization(self):
+        return self._config.zero_enabled
+
+    def zero_optimization_stage(self):
+        return self._config.zero_optimization_stage
+
+    def zero_cpu_offload(self):
+        return self._config.zero_config.cpu_offload
+
+    def zero_reduce_scatter(self):
+        return self._config.zero_config.reduce_scatter
+
+    def zero_overlap_comm(self):
+        return self._config.zero_config.overlap_comm
+
+    def zero_reduce_bucket_size(self):
+        return self._config.zero_config.reduce_bucket_size
+
+    def zero_allgather_bucket_size(self):
+        return self._config.zero_config.allgather_bucket_size
+
+    def zero_contiguous_gradients(self):
+        return self._config.zero_config.contiguous_gradients
+
+    def zero_elastic_checkpoint(self):
+        return self._config.zero_config.elastic_checkpoint
+
+    def zero_load_from_fp32_weights(self):
+        return self._config.zero_config.load_from_fp32_weights
+
+    def allreduce_always_fp32(self):
+        return self._config.allreduce_always_fp32
+
+    def prescale_gradients(self):
+        return self._config.prescale_gradients
+
+    def gradient_predivide_factor(self):
+        return self._config.gradient_predivide_factor
+
+    def sparse_gradients_enabled(self):
+        return self._config.sparse_gradients_enabled
+
+    def postscale_gradients(self):
+        return not self._config.prescale_gradients
+
+    def wall_clock_breakdown(self):
+        return self._config.wall_clock_breakdown
+
+    def memory_breakdown(self):
+        return self._config.memory_breakdown
+
+    def tensorboard_enabled(self):
+        return self._config.tensorboard_enabled
+
+    def tensorboard_output_path(self):
+        return self._config.tensorboard_output_path
+
+    def tensorboard_job_name(self):
+        return self._config.tensorboard_job_name
+
+    def optimizer_name(self):
+        return self._config.optimizer_name
+
+    def optimizer_params(self):
+        return self._config.optimizer_params
+
+    def optimizer_legacy_fusion(self):
+        return self._config.optimizer_legacy_fusion
+
+    def scheduler_name(self):
+        return self._config.scheduler_name
+
+    def scheduler_params(self):
+        return self._config.scheduler_params
+
+    def pld_enabled(self):
+        return self._config.pld_enabled
+
+    def pld_theta(self):
+        return self._config.pld_theta
+
+    def pld_gamma(self):
+        return self._config.pld_gamma
+
+    def elasticity_enabled(self):
+        return self._config.elasticity_enabled
+
+    def dump_state(self):
+        return self._config.dump_state
+
+    def get_global_grad_norm(self):
+        return getattr(self, "_last_grad_norm", None)
+
+    def get_lr(self):
+        return [self._current_lr()]
+
+    def get_mom(self):
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "get_mom"):
+            return self.lr_scheduler.get_mom()
+        return [getattr(self.optimizer, "beta1", 0.9)]
+
+    # ------------------------------------------------------------------
+    # wiring
+    # ------------------------------------------------------------------
+    def _configure_basic_optimizer(self):
+        """Reference analog: engine.py:599-639."""
+        if self.client_optimizer is not None:
+            return self.client_optimizer
+        name = self.optimizer_name()
+        params = dict(self.optimizer_params() or {})
+        if name is None:
+            # default optimizer: Adam (reference requires one; we default sanely)
+            name = ADAM_OPTIMIZER
+        params.pop("torch_adam", None)
+        max_grad_norm = params.pop("max_grad_norm", None)
+        if max_grad_norm and not self._config.gradient_clipping:
+            self._config.gradient_clipping = max_grad_norm
+        if name in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER):
+            from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+
+            params.setdefault("adam_w_mode", name == ADAMW_OPTIMIZER)
+            return FusedAdam(**params)
+        if name == LAMB_OPTIMIZER:
+            from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+
+            return FusedLamb(**params)
+        if name == ONEBIT_ADAM_OPTIMIZER:
+            from deepspeed_tpu.ops.onebit.onebit_adam import OnebitAdam
+
+            return OnebitAdam(mesh=self.mesh, **params)
+        if name == SGD_OPTIMIZER:
+            from deepspeed_tpu.ops.adam.sgd import SGD
+
+            return SGD(**params)
+        raise ValueError(f"Unknown optimizer type {name!r}")
+
+    def _configure_lr_scheduler(self):
+        """Reference analog: engine.py:408-421."""
+        if self.client_lr_scheduler is not None:
+            return self.client_lr_scheduler
+        name = self.scheduler_name()
+        if name is None:
+            return None
+        assert name in SCHEDULER_REGISTRY, f"Unknown scheduler {name}"
+        sched = SCHEDULER_REGISTRY[name](**(self.scheduler_params() or {}))
+        return sched
+
+    def _current_lr(self):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler.get_last_lr()[0] \
+                if getattr(self.lr_scheduler, "_last_lr", None) else \
+                self.lr_scheduler.lr_at(max(0, self.lr_scheduler.last_batch_iteration))
+            return float(lr)
+        return float(getattr(self.optimizer, "lr", 1e-3))
+
+    def deepspeed_io(self, dataset, batch_size=None, route=ROUTE_TRAIN,
+                     pin_memory=False, data_sampler=None, collate_fn=None,
+                     num_local_io_workers=None):
+        """Reference analog: engine.py:731-772."""
+        import jax
+
+        if batch_size is None:
+            batch_size = self.train_micro_batch_size_per_gpu() * self.local_dp_size
+        return DeepSpeedDataLoader(
+            dataset, batch_size=batch_size,
+            collate_fn=collate_fn or self.collate_fn,
+            num_local_io_workers=num_local_io_workers or 0,
+            data_sampler=data_sampler,
+            data_parallel_world_size=jax.process_count(),
+            data_parallel_rank=jax.process_index(),
+            tput_timer=self.tput_timer)
+
+    # ------------------------------------------------------------------
+    # state construction
+    # ------------------------------------------------------------------
+    def _merge_zero_spec(self, tp_specs, template):
+        """Combine TP PartitionSpecs with ZeRO 'data'-axis sharding: shard the
+        largest dim not already taken by TP.  This is the TPU formulation of
+        ZeRO state partitioning (reference stage1.py:426/stage2.py:223-295)."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        dp = self.dp_world_size
+        stage = self.zero_optimization_stage()
+
+        def merge(spec, leaf):
+            if stage == 0 or dp == 1 or leaf.ndim == 0:
+                return spec
+            used = set(a for a in spec if a is not None) if spec else set()
+            if "data" in used:
+                return spec
+            entries = list(spec) + [None] * (leaf.ndim - len(spec))
+            best_dim, best = None, 0
+            for d in range(leaf.ndim):
+                if entries[d] is None and leaf.shape[d] % dp == 0 and leaf.shape[d] > best:
+                    best_dim, best = d, leaf.shape[d]
+            if best_dim is None:
+                return spec
+            entries[best_dim] = "data"
+            return P(*entries)
+
+        return jax.tree_util.tree_map(
+            merge, tp_specs, template,
+            is_leaf=lambda x: isinstance(x, P))
+
+    def _build_shardings(self, params_template):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+        rep = NamedSharding(mesh, P())
+
+        def ns(spec_tree):
+            return jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), spec_tree,
+                is_leaf=lambda x: isinstance(x, P))
+
+        if hasattr(self.module, "param_partition_spec"):
+            tp_spec = self.module.param_partition_spec(params_template)
+        else:
+            tp_spec = jax.tree_util.tree_map(lambda _: P(), params_template)
+
+        param_sh = ns(tp_spec)
+        zero_spec = self._merge_zero_spec(tp_spec, params_template)
+        master_sh = ns(zero_spec) if self.mixed_precision else None
+        opt_leaf_sh = ns(zero_spec)
+        # accum: ZeRO-2 shards gradients; otherwise keep with param layout
+        accum_sh = ns(zero_spec) if self.zero_optimization_stage() >= 2 else param_sh
+
+        opt_state_template = jax.eval_shape(self.optimizer.init_state, params_template)
+        opt_sh = jax.tree_util.tree_map(
+            lambda leaf: rep if leaf.ndim == 0 else None, opt_state_template)
+        # graft per-param shardings into m/v-like subtrees by structure match
+        def fill(sh_leaf, tmpl_leaf, path_cache={}):
+            return sh_leaf
+
+        # build opt sharding tree: scalars replicated, param-shaped leaves follow zero spec
+        flat_opt, opt_def = jax.tree_util.tree_flatten(opt_state_template)
+        flat_param_sh = jax.tree_util.tree_leaves(opt_leaf_sh)
+        param_shapes = [tuple(l.shape) for l in jax.tree_util.tree_leaves(params_template)]
+        sh_by_shape = {}
+        for shp, sh in zip(param_shapes, flat_param_sh):
+            sh_by_shape.setdefault(shp, sh)
+        opt_sh_flat = []
+        for leaf in flat_opt:
+            if leaf.ndim == 0:
+                opt_sh_flat.append(rep)
+            else:
+                opt_sh_flat.append(sh_by_shape.get(tuple(leaf.shape), rep))
+        opt_sh = opt_def.unflatten(opt_sh_flat)
+
+        self._shardings = TrainState(
+            step=rep, micro_step=rep, params=param_sh, opt_state=opt_sh,
+            master=master_sh, accum=accum_sh,
+            scaler=(LossScaleState(rep, rep, rep, rep)
+                    if self._use_loss_scaler() else None),
+            skipped_steps=rep, rng=rep)
+        self._batch_sharding_cache = {}
+        return self._shardings
+
+    def _use_loss_scaler(self):
+        return self.fp16_enabled()
+
+    def _ensure_state(self, batch):
+        if self.state is not None:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        dev_batch = self._shard_batch(batch)
+        init_rng, state_rng = jax.random.split(self._init_rng)
+
+        params_template = jax.eval_shape(
+            lambda r, b: self.module.init(r, b), init_rng, dev_batch)
+        # master template in fp32, compute params in compute dtype
+        self._build_shardings(
+            jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, jnp.float32), params_template))
+
+        param_sh = self._shardings.params
+        master_sh = self._shardings.master
+
+        def init_fn(rng, b):
+            params_f32 = jax.tree_util.tree_map(
+                lambda l: l.astype(jnp.float32), self.module.init(rng, b))
+            return params_f32
+
+        init_jit = jax.jit(init_fn,
+                           out_shardings=master_sh if self.mixed_precision else param_sh)
+        params_f32 = init_jit(init_rng, dev_batch)
+
+        if self.mixed_precision:
+            cast_jit = jax.jit(
+                lambda p: jax.tree_util.tree_map(
+                    lambda l: l.astype(self.compute_dtype), p),
+                out_shardings=param_sh)
+            params = cast_jit(params_f32)
+            master = params_f32
+        else:
+            params = params_f32
+            master = None
+
+        opt_init_jit = jax.jit(self.optimizer.init_state,
+                               out_shardings=self._shardings.opt_state)
+        opt_state = opt_init_jit(master if self.mixed_precision else params)
+
+        accum_template = master if self.mixed_precision else params
+        accum_jit = jax.jit(
+            lambda p: jax.tree_util.tree_map(
+                lambda l: jnp.zeros(l.shape, jnp.float32), p),
+            out_shardings=self._shardings.accum)
+        accum = accum_jit(accum_template)
+
+        scaler = None
+        if self._use_loss_scaler():
+            args = self._config.dynamic_loss_scale_args or {}
+            if self._config.loss_scale and self._config.loss_scale > 0:
+                scaler = make_loss_scale_state(self._config.loss_scale)
+            else:
+                scaler = make_loss_scale_state(
+                    args.get("init_scale", self._config.initial_dynamic_scale),
+                    delayed_shift=args.get("delayed_shift", 1))
+
+        self.state = TrainState(
+            step=jnp.int32(0), micro_step=jnp.int32(0), params=params,
+            opt_state=opt_state, master=master, accum=accum, scaler=scaler,
+            skipped_steps=jnp.int32(0), rng=state_rng)
+        n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+        log_dist(f"Initialized model state: {n_params/1e6:.1f}M params "
+                 f"in {time.time()-t0:.1f}s", ranks=[0])
+
+    def _shard_batch(self, batch):
+        """Host batch -> device arrays with dim0 sharded over 'data'."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+
+        dp = self.dp_world_size
+
+        def put(x):
+            x = np.asarray(x)
+            if x.ndim >= 1 and x.shape[0] % max(1, dp // jax.process_count()) != 0:
+                raise ValueError(
+                    f"Batch dim0={x.shape[0]} is not divisible by the local "
+                    f"data-parallel degree; feed "
+                    f"train_micro_batch_size_per_gpu*local_dp = "
+                    f"{self.train_micro_batch_size_per_gpu() * self.local_dp_size} rows")
+            sh = NamedSharding(mesh, P(*(["data"] + [None] * (x.ndim - 1))))
+            if jax.process_count() > 1:
+                return jax.make_array_from_process_local_data(sh, x)
+            return jax.device_put(x, sh)
+
+        return jax.tree_util.tree_map(put, batch)
+
+    # ------------------------------------------------------------------
+    # jitted steps
+    # ------------------------------------------------------------------
+    def _scaler_hparams(self):
+        args = self._config.dynamic_loss_scale_args or {}
+        return dict(
+            scale_window=args.get("scale_window", 1000),
+            min_scale=args.get("min_scale", 1.0),
+            delayed_shift=args.get("delayed_shift", 1),
+            dynamic=self.dynamic_loss_scale())
+
+    def _make_micro_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        gas = self.gradient_accumulation_steps()
+        model = self.module
+
+        def micro(state: TrainState, batch):
+            rng = jax.random.fold_in(state.rng, state.micro_step + state.step * 131071)
+
+            def loss_fn(params):
+                loss, metrics = model.loss(params, batch, rng, train=True)
+                scale = state.scaler.loss_scale if state.scaler is not None else 1.0
+                return loss.astype(jnp.float32) * scale / gas, (loss, metrics)
+
+            grads, (loss, metrics) = jax.grad(loss_fn, has_aux=True)(state.params)
+            accum = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), state.accum, grads)
+            new_state = state._replace(accum=accum, micro_step=state.micro_step + 1)
+            return new_state, loss
+
+        return micro
+
+    def _make_apply_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        clip = self.gradient_clipping()
+        scaler_hp = self._scaler_hparams()
+        optimizer = self.optimizer
+        mixed = self.mixed_precision
+        compute_dtype = self.compute_dtype
+
+        def apply(state: TrainState, lr):
+            scale = state.scaler.loss_scale if state.scaler is not None else jnp.float32(1.0)
+            # overflow check on raw accumulated (scaled) grads
+            finite = jnp.asarray(True)
+            for g in jax.tree_util.tree_leaves(state.accum):
+                finite &= jnp.all(jnp.isfinite(g))
+            overflow = ~finite
+
+            def do_update(st):
+                grads = jax.tree_util.tree_map(lambda g: g / scale, st.accum)
+                if clip and clip > 0:
+                    gnorm = jnp.sqrt(sum(
+                        jnp.sum(jnp.square(g))
+                        for g in jax.tree_util.tree_leaves(grads)))
+                    factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                    grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
+                else:
+                    gnorm = jnp.float32(0.0)
+                master = st.master if mixed else st.params
+                new_master, new_opt = optimizer.update(
+                    grads, st.opt_state, master, lr=lr)
+                if mixed:
+                    new_params = jax.tree_util.tree_map(
+                        lambda l: l.astype(compute_dtype), new_master)
+                    return st._replace(params=new_params, master=new_master,
+                                       opt_state=new_opt, step=st.step + 1), gnorm
+                return st._replace(params=new_master, opt_state=new_opt,
+                                   step=st.step + 1), gnorm
+
+            def skip_update(st):
+                return st._replace(skipped_steps=st.skipped_steps + 1,
+                                   step=st.step + 1), jnp.float32(0.0)
+
+            new_state, gnorm = jax.lax.cond(overflow, skip_update, do_update, state)
+            if state.scaler is not None:
+                new_scaler = update_loss_scale(new_state.scaler, overflow, **scaler_hp)
+                new_state = new_state._replace(scaler=new_scaler)
+            zero_accum = jax.tree_util.tree_map(jnp.zeros_like, new_state.accum)
+            new_state = new_state._replace(accum=zero_accum, micro_step=jnp.int32(0))
+            return new_state, {"overflow": overflow, "grad_norm": gnorm,
+                               "loss_scale": scale}
+
+        return apply
+
+    def _compile(self):
+        if self._jit_micro is not None:
+            return
+        import jax
+
+        sh = self._shardings
+        micro = self._make_micro_fn()
+        apply_ = self._make_apply_fn()
+
+        # NOTE: the micro step does NOT donate its input state — backward()
+        # commits the staged state, so forward() without backward() (eval,
+        # discarded micro-batch) must leave the accumulator untouched.
+        self._jit_micro = jax.jit(micro, out_shardings=(sh, None))
+        self._jit_apply = jax.jit(apply_, donate_argnums=(0,), out_shardings=(sh, None))
+
+        gas = self.gradient_accumulation_steps()
+
+        def fused(state, stacked_batch, lr):
+            def body(st, b):
+                st, loss = micro(st, b)
+                return st, loss
+
+            state, losses = jax.lax.scan(body, state, stacked_batch)
+            state, metrics = apply_(state, lr)
+            metrics["loss"] = losses.mean()
+            return state, metrics
+
+        self._jit_fused = jax.jit(fused, donate_argnums=(0,), out_shardings=(sh, None))
+
+    # ------------------------------------------------------------------
+    # public training API (reference semantics)
+    # ------------------------------------------------------------------
+    def forward(self, batch):
+        """Compute the micro-batch loss (grads are computed alongside and
+        committed by backward(), keeping one-fwd-one-bwd cost parity)."""
+        if self.wall_clock_breakdown():
+            self.timers(FORWARD_MICRO_TIMER).start()
+        self._ensure_state(batch)
+        self._compile()
+        dev_batch = self._shard_batch(batch)
+        new_state, loss = self._jit_micro(self.state, dev_batch)
+        # torch-parity semantics: gradients only land when backward() commits
+        # the staged state; a forward without backward contributes nothing.
+        self._pending_state = new_state
+        self._pending_loss = loss
+        if self.wall_clock_breakdown():
+            self.timers(FORWARD_MICRO_TIMER).stop()
+        return loss
+
+    def __call__(self, batch):
+        return self.forward(batch)
+
+    def backward(self, loss=None, allreduce_gradients=True):
+        """Commit the gradients of the last forward (reference engine.py:871).
+
+        In the functional engine the grads were already accumulated by
+        forward(); backward() validates call order and handles timing.
+        """
+        if self.wall_clock_breakdown():
+            self.timers(BACKWARD_MICRO_TIMER).start()
+        assert self._pending_state is not None, \
+            "backward() called without a preceding forward()"
+        self.state = self._pending_state
+        self._pending_state = None
+        self.micro_steps += 1
+        if self.wall_clock_breakdown():
+            self.timers(BACKWARD_MICRO_TIMER).stop()
+        return loss
+
+    def is_gradient_accumulation_boundary(self):
+        return self.micro_steps % self.gradient_accumulation_steps() == 0
+
+    def step(self):
+        """Optimizer step at accumulation boundaries (reference engine.py:1016)."""
+        if self.wall_clock_breakdown():
+            self.timers(STEP_MICRO_TIMER).start()
+        assert self._pending_state is None, \
+            "step() called between forward() and backward()"
+        if self.is_gradient_accumulation_boundary():
+            self._take_model_step()
+        if self.wall_clock_breakdown():
+            self.timers(STEP_MICRO_TIMER).stop()
+
+    def _take_model_step(self):
+        lr = self._advance_lr()
+        import jax.numpy as jnp
+
+        new_state, metrics = self._jit_apply(self.state, jnp.float32(lr))
+        self.state = new_state
+        self.global_steps += 1
+        self._last_metrics = metrics
+        self._last_grad_norm = metrics["grad_norm"]
+        if bool(metrics["overflow"]):
+            self.skipped_steps += 1
+            log_dist(f"OVERFLOW! Skipping step. loss scale -> "
+                     f"{float(self.state.scaler.loss_scale) if self.state.scaler else 1}",
+                     ranks=[0])
+        if self.global_steps % self.steps_per_print() == 0:
+            self._report_progress(self.global_steps)
+        self._write_monitor({"lr": lr,
+                             "loss_scale": float(metrics["loss_scale"]),
+                             "grad_norm": float(metrics["grad_norm"])})
+
+    def _advance_lr(self):
+        if self.lr_scheduler is not None:
+            return float(self.lr_scheduler.step())
+        return self._current_lr()
+
+    def train_batch(self, data_iter=None, batch=None):
+        """Fused full-batch step: gas micro-batches + optimizer step in ONE jit
+        (lax.scan over microbatches).  The fast path used for benchmarks."""
+        gas = self.gradient_accumulation_steps()
+        if batch is None:
+            assert data_iter is not None
+            micros = [next(data_iter) for _ in range(gas)]
+            batch = _stack_batches(micros)
+        self._ensure_state(_first_micro(batch))
+        self._compile()
+        dev = self._shard_stacked_batch(batch)
+        lr = self._advance_lr()
+        import jax.numpy as jnp
+
+        self.tput_timer.start()
+        new_state, metrics = self._jit_fused(self.state, dev, jnp.float32(lr))
+        self.state = new_state
+        self.global_steps += 1
+        self.micro_steps += gas
+        self._last_metrics = metrics
+        self._last_grad_norm = metrics["grad_norm"]
+        self.tput_timer.stop()
+        if self.global_steps % self.steps_per_print() == 0:
+            self._report_progress(self.global_steps)
+        return metrics["loss"]
+
+    def eval_loss(self, batch):
+        import jax
+
+        self._ensure_state(batch)
+        if self._jit_eval is None:
+            model = self.module
+
+            def ev(state, b):
+                loss, metrics = model.loss(state.params, b, state.rng, train=False)
+                return loss
+
+            self._jit_eval = jax.jit(ev)
+        return self._jit_eval(self.state, self._shard_batch(batch))
+
+    def _shard_stacked_batch(self, batch):
+        """Batch with leading (gas, batch...) dims: shard dim1 over data."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+
+        def put(x):
+            x = np.asarray(x)
+            sh = NamedSharding(mesh, P(*([None, "data"] + [None] * (x.ndim - 2))))
+            if jax.process_count() > 1:
+                return jax.make_array_from_process_local_data(sh, x)
+            return jax.device_put(x, sh)
+
+        return jax.tree_util.tree_map(put, batch)
+
+    def _report_progress(self, step):
+        lr = self._current_lr()
+        scale = self.loss_scale() if self.fp16_enabled() else 1
+        log_dist(f"step={step}, skipped={self.skipped_steps}, lr={lr:g}, "
+                 f"scale={scale:g}", ranks=[0])
+
+    def _write_monitor(self, scalars: dict):
+        if self._monitor_file is None:
+            return
+        import json
+
+        with open(self._monitor_file, "a") as f:
+            f.write(json.dumps({"step": self.global_steps, **scalars}) + "\n")
+
+    # ------------------------------------------------------------------
+    # checkpointing (reference engine.py:1279-1597; layout kept similar)
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        import jax
+
+        assert self.state is not None, "nothing to save; train state not built"
+        client_state = client_state or {}
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        path = os.path.join(save_dir, str(tag))
+        os.makedirs(path, exist_ok=True)
+
+        if jax.process_index() == 0:
+            host_state = jax.device_get(self.state)
+            flat, treedef = jax.tree_util.tree_flatten(host_state)
+            np.savez(os.path.join(path, "model_states.npz"),
+                     **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(flat)})
+            meta = {
+                "global_steps": self.global_steps,
+                "micro_steps": self.micro_steps,
+                "skipped_steps": self.skipped_steps,
+                "dp_world_size": self.dp_world_size,
+                "lr_scheduler": self.lr_scheduler.state_dict()
+                if self.lr_scheduler is not None else None,
+                "client_state": client_state,
+                "num_leaves": len(flat),
+            }
+            with open(os.path.join(path, "metadata.pkl"), "wb") as f:
+                pickle.dump(meta, f)
+            if save_latest:
+                with open(os.path.join(save_dir, "latest"), "w") as f:
+                    f.write(str(tag))
+        log_dist(f"Saved checkpoint {path}", ranks=[0])
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
+                        load_optimizer_states=True, load_lr_scheduler_states=True):
+        import jax
+
+        if tag is None:
+            latest = os.path.join(load_dir, "latest")
+            if not os.path.exists(latest):
+                logger.warning(f"No 'latest' file at {load_dir}; nothing loaded")
+                return None, {}
+            with open(latest) as f:
+                tag = f.read().strip()
+        path = os.path.join(load_dir, str(tag))
+        with open(os.path.join(path, "metadata.pkl"), "rb") as f:
+            meta = pickle.load(f)
+        data = np.load(os.path.join(path, "model_states.npz"))
+        flat = [data[f"leaf_{i}"] for i in range(meta["num_leaves"])]
+
+        assert self.state is not None, \
+            "call forward/train_batch once (or init_from_batch) before load_checkpoint"
+        treedef = jax.tree_util.tree_structure(self.state)
+        host_state = jax.tree_util.tree_unflatten(treedef, flat)
+        # re-shard onto the current mesh: elastic by construction — the full
+        # arrays repartition to any world size (reference stage1.py:1197-1255)
+        sh_flat = jax.tree_util.tree_leaves(self._shardings)
+        dev_flat = [jax.device_put(l, s) for l, s in
+                    zip(jax.tree_util.tree_leaves(host_state), sh_flat)]
+        self.state = jax.tree_util.tree_unflatten(treedef, dev_flat)
+
+        self.global_steps = meta["global_steps"]
+        self.micro_steps = meta["micro_steps"]
+        self.skipped_steps = meta["skipped_steps"]
+        if load_lr_scheduler_states and self.lr_scheduler is not None \
+                and meta.get("lr_scheduler") is not None:
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        log_dist(f"Loaded checkpoint {path} (saved at dp={meta['dp_world_size']}, "
+                 f"now dp={self.dp_world_size})", ranks=[0])
+        return path, meta.get("client_state", {})
+
+    def init_from_batch(self, batch):
+        """Explicitly build train state from a sample batch (e.g. before
+        load_checkpoint without training first)."""
+        self._ensure_state(batch)
+        self._compile()
+
+
+def _stack_batches(micros):
+    return {k: np.stack([np.asarray(m[k]) for m in micros]) for k in micros[0]} \
+        if isinstance(micros[0], dict) else np.stack([np.asarray(m) for m in micros])
+
+
+def _first_micro(batch):
+    if isinstance(batch, dict):
+        return {k: v[0] for k, v in batch.items()}
+    return batch[0]

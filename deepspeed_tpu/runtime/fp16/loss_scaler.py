@@ -1,0 +1,150 @@
+"""Static and dynamic loss scaling.
+
+Reference semantics: deepspeed/runtime/fp16/loss_scaler.py:56-221 —
+``DynamicLossScaler`` doubles every ``scale_window`` overflow-free iterations,
+halves on overflow, with ``delayed_shift`` hysteresis and a ``min_scale`` floor.
+
+TPU-native form: the scaler is a small pytree (`LossScaleState`) updated inside
+the jitted train step with ``lax.cond`` — the data-dependent skip/halve logic
+stays on-device, no host sync (SURVEY §7 "hard parts").  The host-facing
+``LossScaler`` / ``DynamicLossScaler`` classes keep the reference API for
+config plumbing and tests.
+"""
+from typing import NamedTuple
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScaleState(NamedTuple):
+    """Device-side scaler state (all scalars)."""
+    loss_scale: object          # f32
+    cur_iter: object            # i32
+    last_overflow_iter: object  # i32
+    cur_hysteresis: object      # i32
+
+
+def make_loss_scale_state(init_scale, delayed_shift=1):
+    import jax.numpy as jnp
+
+    return LossScaleState(
+        loss_scale=jnp.float32(init_scale),
+        cur_iter=jnp.int32(0),
+        last_overflow_iter=jnp.int32(-1),
+        cur_hysteresis=jnp.int32(delayed_shift))
+
+
+def update_loss_scale(state: LossScaleState, overflow, *, scale_factor=2.0,
+                      scale_window=1000, min_scale=1.0, delayed_shift=1,
+                      consecutive_hysteresis=False, dynamic=True):
+    """Pure update implementing the reference update_scale (loss_scaler.py:151)."""
+    import jax.numpy as jnp
+
+    if not dynamic:
+        return LossScaleState(state.loss_scale, state.cur_iter + 1,
+                              state.last_overflow_iter, state.cur_hysteresis)
+
+    def on_overflow(s):
+        shift_now = s.cur_hysteresis <= 1
+        new_scale = jnp.where(shift_now,
+                              jnp.maximum(s.loss_scale / scale_factor,
+                                          jnp.float32(min_scale)),
+                              s.loss_scale)
+        new_hyst = jnp.where(shift_now, s.cur_hysteresis, s.cur_hysteresis - 1)
+        return LossScaleState(new_scale, s.cur_iter + 1, s.cur_iter, new_hyst)
+
+    def on_good(s):
+        window_hit = jnp.logical_and(
+            scale_window > 0,
+            (s.cur_iter - s.last_overflow_iter) % scale_window == 0)
+        new_scale = jnp.where(window_hit, s.loss_scale * scale_factor, s.loss_scale)
+        if consecutive_hysteresis:
+            new_hyst = jnp.int32(delayed_shift)
+        else:
+            new_hyst = jnp.where(window_hit, jnp.int32(delayed_shift), s.cur_hysteresis)
+        return LossScaleState(new_scale, s.cur_iter + 1, s.last_overflow_iter, new_hyst)
+
+    import jax
+
+    return jax.lax.cond(overflow, on_overflow, on_good, state)
+
+
+# ---------------------------------------------------------------------------
+# Host-facing classes (API parity with reference loss_scaler.py)
+# ---------------------------------------------------------------------------
+
+class LossScalerBase:
+    def __init__(self, cur_scale):
+        self.cur_scale = cur_scale
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, grads):
+        import jax
+
+        return jax.tree_util.tree_map(lambda g: g * self.loss_scale, grads)
+
+    def update_scale(self, overflow):
+        pass
+
+    def backward(self, loss):
+        return loss * self.loss_scale
+
+
+class LossScaler(LossScalerBase):
+    """Static loss scale (reference :56-77): never reports overflow."""
+
+    def __init__(self, scale=1):
+        super().__init__(scale)
+
+    def has_overflow(self, params):
+        return False
+
+
+class DynamicLossScaler(LossScalerBase):
+    """Dynamic scaler (reference :79-221)."""
+
+    def __init__(self, init_scale=2 ** 32, scale_factor=2., scale_window=1000,
+                 min_scale=1, delayed_shift=1, consecutive_hysteresis=False):
+        super().__init__(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = delayed_shift
+        self.cur_hysteresis = delayed_shift
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    def update_scale(self, overflow):
+        if overflow:
+            if self.delayed_shift == 1 or self.cur_hysteresis == 1:
+                self.cur_scale = max(self.cur_scale / self.scale_factor, self.min_scale)
+            else:
+                self.cur_hysteresis -= 1
+            self.last_overflow_iter = self.cur_iter
+        else:
+            if self.consecutive_hysteresis:
+                self.cur_hysteresis = self.delayed_shift
+            if (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+                if not self.consecutive_hysteresis:
+                    self.cur_hysteresis = self.delayed_shift
+                self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+
+def CreateLossScaler(static_loss_scale=0, dynamic_scale_args=None):
+    """Factory matching the engine's config semantics: loss_scale==0 => dynamic."""
+    if static_loss_scale and static_loss_scale > 0:
+        return LossScaler(scale=static_loss_scale)
+    if dynamic_scale_args:
+        return DynamicLossScaler(
+            init_scale=dynamic_scale_args.get(INITIAL_LOSS_SCALE, 2 ** 32),
+            scale_window=dynamic_scale_args.get(SCALE_WINDOW, 1000),
+            delayed_shift=dynamic_scale_args.get(DELAYED_SHIFT, 1),
+            min_scale=dynamic_scale_args.get(MIN_LOSS_SCALE, 1))
+    return DynamicLossScaler()

@@ -1,0 +1,8 @@
+"""PipelineEngine — placeholder until the pipeline milestone."""
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+
+class PipelineEngine(DeepSpeedEngine):
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "PipelineEngine is implemented in the pipeline milestone")

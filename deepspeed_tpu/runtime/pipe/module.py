@@ -1,0 +1,33 @@
+"""PipelineModule — placeholder until the pipeline engine lands.
+
+Real implementation: LayerSpec/TiedLayerSpec partitioning over pipe stages
+(reference: deepspeed/runtime/pipe/module.py:85).
+"""
+
+
+class LayerSpec:
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+
+class TiedLayerSpec(LayerSpec):
+    def __init__(self, key, typename, *module_args, forward_fn=None,
+                 tied_weight_attr="embedding", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class PipelineModule:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "PipelineModule is implemented in the pipeline milestone")
+
+    def mpu(self):
+        return None

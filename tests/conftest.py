@@ -7,13 +7,19 @@ as it would across chips.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+# force the CPU mesh even when a TPU plugin (axon) injects itself into
+# jax_platforms; opt out with DSTPU_TEST_PLATFORM=tpu to run on real hardware
+_platform = os.environ.get("DSTPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 
 import jax  # noqa: E402
 
+if _platform == "cpu":
+    # NOT redundant with the env var: the axon TPU plugin prepends itself to
+    # jax_platforms at import ("axon,cpu") even when JAX_PLATFORMS=cpu is set;
+    # only an explicit config update wins.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_threefry_partitionable", True)
 
 import pytest  # noqa: E402

@@ -1,0 +1,99 @@
+"""Test fixtures — analog of reference tests/unit/simple_model.py.
+
+SimpleModel: tiny MLP classifier implementing the engine model contract
+directly (no flax), so engine mechanics are testable fast on the CPU mesh.
+"""
+import json
+import os
+
+import numpy as np
+
+
+class SimpleModel:
+    """hidden -> hidden -> nclass linear classifier with CE loss.
+
+    empty_grad mirrors the reference's unused-parameter edge case
+    (simple_model.py:10-24): an extra linear layer never used in the loss,
+    so its gradient is identically zero.
+    """
+
+    def __init__(self, hidden_dim=10, n_classes=4, empty_grad=False):
+        self.hidden_dim = hidden_dim
+        self.n_classes = n_classes
+        self.empty_grad = empty_grad
+
+    def init(self, rng, batch):
+        import jax
+
+        k1, k2, k3 = jax.random.split(rng, 3)
+        params = {
+            "w1": jax.random.normal(k1, (self.hidden_dim, self.hidden_dim)) * 0.1,
+            "b1": jax.numpy.zeros((self.hidden_dim,)),
+            "w2": jax.random.normal(k2, (self.hidden_dim, self.n_classes)) * 0.1,
+            "b2": jax.numpy.zeros((self.n_classes,)),
+        }
+        if self.empty_grad:
+            params["unused"] = jax.random.normal(k3, (self.hidden_dim, self.hidden_dim))
+        return params
+
+    def loss(self, params, batch, rng, train=True):
+        import jax
+        import jax.numpy as jnp
+
+        x = batch["x"]
+        h = jnp.tanh(x @ params["w1"].astype(x.dtype) + params["b1"].astype(x.dtype))
+        logits = h @ params["w2"].astype(x.dtype) + params["b2"].astype(x.dtype)
+        logits = logits.astype(jnp.float32)
+        labels = batch["y"]
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+        return loss, {"loss": loss}
+
+
+def random_dataset(total_samples, hidden_dim, n_classes=4, seed=0):
+    """Learnable synthetic task: labels from a fixed random linear teacher."""
+    rs = np.random.RandomState(seed)
+    x = rs.randn(total_samples, hidden_dim).astype(np.float32)
+    teacher = np.random.RandomState(1234).randn(hidden_dim, n_classes)
+    y = np.argmax(x @ teacher, axis=1).astype(np.int32)
+    return x, y
+
+
+def random_dataloader(model_cfg_hidden, total_samples, batch_size, n_classes=4,
+                      seed=0):
+    """Yields dict batches, restarting forever."""
+    x, y = random_dataset(total_samples, model_cfg_hidden, n_classes, seed)
+
+    def gen():
+        i = 0
+        while True:
+            sl = slice((i * batch_size) % total_samples,
+                       (i * batch_size) % total_samples + batch_size)
+            bx, by = x[sl], y[sl]
+            if len(bx) < batch_size:
+                i = 0
+                continue
+            yield {"x": bx, "y": by}
+            i += 1
+
+    return gen()
+
+
+def batches_list(n_batches, batch_size, hidden_dim, n_classes=4, seed=0):
+    it = random_dataloader(hidden_dim, n_batches * batch_size, batch_size,
+                           n_classes, seed)
+    return [next(it) for _ in range(n_batches)]
+
+
+def args_from_dict(tmpdir, config_dict):
+    """Write ds_config json + argparse namespace (reference simple_model.py)."""
+    import argparse
+
+    config_path = os.path.join(str(tmpdir), "ds_config.json")
+    with open(config_path, "w") as f:
+        json.dump(config_dict, f)
+    args = argparse.Namespace()
+    args.deepspeed = True
+    args.deepspeed_config = config_path
+    args.local_rank = 0
+    return args

@@ -1,0 +1,111 @@
+"""ZeRO stage 1/2 tests on the 8-device CPU mesh.
+
+Mirrors reference tests/unit/test_zero.py (unbalanced/missing gradients) and
+adds what the reference proves via construction: that optimizer state is
+actually partitioned over the data axis.
+"""
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from simple_model import SimpleModel, random_dataloader
+
+HIDDEN = 16
+
+
+def zero_config(stage, **over):
+    cfg = {
+        "train_batch_size": 8,
+        "steps_per_print": 100,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "fp16": {"enabled": True, "initial_scale_power": 8},
+        "zero_optimization": {"stage": stage},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def run_steps(engine, n=10):
+    it = random_dataloader(
+        HIDDEN, 64, engine.train_micro_batch_size_per_gpu() * engine.dp_world_size)
+    losses = []
+    for _ in range(n):
+        loss = engine.forward(next(it))
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.mark.parametrize("stage", [1, 2])
+def test_zero_trains(stage):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(HIDDEN), config_params=zero_config(stage))
+    losses = run_steps(engine, 15)
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("stage", [1, 2])
+def test_zero_unbalanced_gradients(stage):
+    """Params with identically-zero grads (reference test_zero.py:31-69)."""
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(HIDDEN, empty_grad=True),
+        config_params=zero_config(stage))
+    losses = run_steps(engine, 8)
+    assert np.isfinite(losses).all()
+
+
+def test_zero_state_is_partitioned():
+    """ZeRO-1: master weights + Adam moments sharded over 'data';
+    ZeRO-0 baseline: replicated."""
+    e0, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(HIDDEN), config_params=zero_config(0))
+    e1, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(HIDDEN), config_params=zero_config(1))
+    run_steps(e0, 1)
+    run_steps(e1, 1)
+
+    def shard_counts(state):
+        # number of distinct device shards of the Adam m buffer for w1
+        arr = state.opt_state.m["w1"]
+        return len({s.index for s in arr.addressable_shards})
+
+    assert shard_counts(e0.state) == 1 or \
+        all(s.index == e0.state.opt_state.m["w1"].addressable_shards[0].index
+            for s in e0.state.opt_state.m["w1"].addressable_shards)
+    # stage1: w1 is (16,16), dp=8 -> sharded into 8 distinct slices
+    assert shard_counts(e1.state) == 8
+
+    # memory parity: each shard holds 1/8 of the elements
+    shard = e1.state.opt_state.m["w1"].addressable_shards[0]
+    assert shard.data.size == 16 * 16 // 8
+
+
+def test_zero2_accum_partitioned():
+    """ZeRO-2 also shards the gradient accumulator."""
+    e2, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(HIDDEN), config_params=zero_config(2))
+    run_steps(e2, 1)
+    accum_shard = e2.state.accum["w1"].addressable_shards[0]
+    assert accum_shard.data.size == 16 * 16 // 8
+    # stage1 keeps accum replicated (grad partitioning is the stage-2 feature)
+    e1, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(HIDDEN), config_params=zero_config(1))
+    run_steps(e1, 1)
+    accum_shard1 = e1.state.accum["w1"].addressable_shards[0]
+    assert accum_shard1.data.size == 16 * 16
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2])
+def test_zero_stages_same_trajectory(stage):
+    """All stages compute the same math: loss trajectories must match the
+    unsharded baseline closely (sharding only changes layout)."""
+    base, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(HIDDEN),
+        config_params=zero_config(0, fp16={"enabled": False}))
+    test, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(HIDDEN),
+        config_params=zero_config(stage, fp16={"enabled": False}))
+    lb = run_steps(base, 8)
+    lt = run_steps(test, 8)
+    np.testing.assert_allclose(lb, lt, rtol=2e-4)

@@ -21,6 +21,7 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models.api import cross_entropy_loss
 from deepspeed_tpu.ops.transformer.functional import scaled_dot_product_attention
+from deepspeed_tpu.parallel import mesh as mesh_lib
 
 
 @dataclasses.dataclass(frozen=True)
@@ -114,7 +115,7 @@ class Block(nn.Module):
             nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                          name="ln_2")(x), train)
         # keep activations sharded batch-over-data as blocks stack
-        x = jax.lax.with_sharding_constraint(x, P("data", None, None))
+        x = mesh_lib.constrain(x, P("data", None, None))
         return x
 
 

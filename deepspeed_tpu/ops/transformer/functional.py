@@ -25,6 +25,9 @@ def scaled_dot_product_attention(q, k, v, *, mask=None, bias=None, causal=False,
     if use_pallas is None:
         use_pallas = _pallas_attention_ok(q, k, v, mask, bias, dropout_rate)
     if use_pallas:
+        assert mask is None and bias is None and dropout_rate == 0.0, (
+            "pallas flash attention supports causal masking only; mask/bias/"
+            "dropout require use_pallas=False (jnp path)")
         from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal, scale=scale)

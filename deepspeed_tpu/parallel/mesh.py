@@ -57,6 +57,30 @@ def build_mesh(mesh_shape: Optional[dict] = None, devices=None):
     return Mesh(dev_array, AXIS_ORDER)
 
 
+def constrain(x, spec):
+    """with_sharding_constraint that no-ops when no mesh is active or the
+    referenced axes are absent/trivial — lets model code carry sharding
+    annotations that only bind inside an engine's mesh context."""
+    import jax
+
+    from jax.sharding import PartitionSpec as P
+
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return x
+
+    def keep(axis):
+        if axis is None:
+            return None
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        kept = tuple(a for a in axes if a in mesh.shape)
+        if not kept:
+            return None
+        return kept if len(kept) > 1 else kept[0]
+
+    return jax.lax.with_sharding_constraint(x, P(*(keep(a) for a in spec)))
+
+
 def data_sharding(mesh, *, extra_dims: int = 1):
     """NamedSharding for a batch: dim0 over 'data', rest replicated."""
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -82,34 +106,50 @@ def pp_size(mesh) -> int:
     return mesh.shape[PIPE_AXIS]
 
 
-def zero_partition_spec(pytree, mesh, stage: int):
-    """Sharding specs implementing ZeRO state partitioning over the data axis.
+def zero_merge_spec(spec, leaf, dp: int):
+    """Merge ZeRO 'data'-axis sharding into an existing (TP) PartitionSpec.
 
     The reference flattens params and slices 1/N per rank
-    (stage1.py:426, stage2.py:223-295).  The TPU-native formulation keeps leaves
-    in natural shape and shards the largest dimension divisible by the
-    data-parallel size; XLA then reduce-scatters grads into the shard and
-    all-gathers updated params — same memory footprint, no bucket machinery.
-    Leaves too small to shard stay replicated (same as reference's final
-    unpartitioned remainder).
+    (stage1.py:426, stage2.py:223-295).  The TPU-native formulation keeps
+    leaves in natural shape and shards the largest dimension not already
+    taken by TP that divides the data-parallel size; XLA then
+    reduce-scatters grads into the shard and all-gathers updated params —
+    same memory footprint, no bucket machinery.  Leaves too small to shard
+    stay replicated (the reference's unpartitioned remainder).
     """
+    from jax.sharding import PartitionSpec as P
+
+    if dp == 1 or not hasattr(leaf, "shape") or leaf.ndim == 0:
+        return spec
+    used = set(a for a in spec if a is not None) if spec else set()
+    if DATA_AXIS in used:
+        return spec
+    entries = list(spec) + [None] * (leaf.ndim - len(spec))
+    best_dim, best = None, 0
+    for d in range(leaf.ndim):
+        if entries[d] is None and leaf.shape[d] % dp == 0 and leaf.shape[d] > best:
+            best_dim, best = d, leaf.shape[d]
+    if best_dim is None:
+        return spec
+    entries[best_dim] = DATA_AXIS
+    return P(*entries)
+
+
+def zero_partition_spec(pytree, mesh, stage: int, tp_specs=None):
+    """Sharding specs implementing ZeRO state partitioning over the data
+    axis, layered on top of optional tensor-parallel specs."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     dp = dp_size(mesh)
 
-    def spec_for(leaf):
-        if stage == 0 or dp == 1 or not hasattr(leaf, "shape") or leaf.ndim == 0:
-            return NamedSharding(mesh, P())
-        # choose the largest dim divisible by dp
-        best_dim, best_size = None, 0
-        for d, s in enumerate(leaf.shape):
-            if s % dp == 0 and s > best_size:
-                best_dim, best_size = d, s
-        if best_dim is None:
-            return NamedSharding(mesh, P())
-        spec = [None] * leaf.ndim
-        spec[best_dim] = DATA_AXIS
-        return NamedSharding(mesh, P(*spec))
+    if tp_specs is None:
+        tp_specs = jax.tree_util.tree_map(lambda _: P(), pytree)
 
-    return jax.tree_util.tree_map(spec_for, pytree)
+    def spec_for(spec, leaf):
+        if stage == 0:
+            return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, zero_merge_spec(spec, leaf, dp))
+
+    return jax.tree_util.tree_map(
+        spec_for, tp_specs, pytree, is_leaf=lambda x: isinstance(x, P))

@@ -80,7 +80,6 @@ class DeepSpeedEngine:
         self.mpu = mpu
         self.global_steps = 0
         self.micro_steps = 0
-        self.skipped_steps = 0
         self.gradient_average = True
         self.warn_unscaled_loss = True
 
@@ -296,6 +295,16 @@ class DeepSpeedEngine:
     def get_global_grad_norm(self):
         return getattr(self, "_last_grad_norm", None)
 
+    @property
+    def skipped_steps(self):
+        """Overflow-skipped step count; lives on-device in the train state
+        (synced on access, not per step)."""
+        if self.state is None:
+            return 0
+        import jax
+
+        return int(jax.device_get(self.state.skipped_steps))
+
     def get_lr(self):
         return [self._current_lr()]
 
@@ -378,36 +387,6 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # state construction
     # ------------------------------------------------------------------
-    def _merge_zero_spec(self, tp_specs, template):
-        """Combine TP PartitionSpecs with ZeRO 'data'-axis sharding: shard the
-        largest dim not already taken by TP.  This is the TPU formulation of
-        ZeRO state partitioning (reference stage1.py:426/stage2.py:223-295)."""
-        import jax
-        from jax.sharding import PartitionSpec as P
-
-        dp = self.dp_world_size
-        stage = self.zero_optimization_stage()
-
-        def merge(spec, leaf):
-            if stage == 0 or dp == 1 or leaf.ndim == 0:
-                return spec
-            used = set(a for a in spec if a is not None) if spec else set()
-            if "data" in used:
-                return spec
-            entries = list(spec) + [None] * (leaf.ndim - len(spec))
-            best_dim, best = None, 0
-            for d in range(leaf.ndim):
-                if entries[d] is None and leaf.shape[d] % dp == 0 and leaf.shape[d] > best:
-                    best_dim, best = d, leaf.shape[d]
-            if best_dim is None:
-                return spec
-            entries[best_dim] = "data"
-            return P(*entries)
-
-        return jax.tree_util.tree_map(
-            merge, tp_specs, template,
-            is_leaf=lambda x: isinstance(x, P))
-
     def _build_shardings(self, params_template):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -425,33 +404,44 @@ class DeepSpeedEngine:
         else:
             tp_spec = jax.tree_util.tree_map(lambda _: P(), params_template)
 
+        stage = self.zero_optimization_stage()
+        dp = self.dp_world_size
+        zero_spec = jax.tree_util.tree_map(
+            lambda s, l: mesh_lib.zero_merge_spec(s, l, dp) if stage > 0 else s,
+            tp_spec, params_template, is_leaf=lambda x: isinstance(x, P))
+
         param_sh = ns(tp_spec)
-        zero_spec = self._merge_zero_spec(tp_spec, params_template)
         master_sh = ns(zero_spec) if self.mixed_precision else None
-        opt_leaf_sh = ns(zero_spec)
         # accum: ZeRO-2 shards gradients; otherwise keep with param layout
-        accum_sh = ns(zero_spec) if self.zero_optimization_stage() >= 2 else param_sh
+        accum_sh = ns(zero_spec) if stage >= 2 else param_sh
 
         opt_state_template = jax.eval_shape(self.optimizer.init_state, params_template)
-        opt_sh = jax.tree_util.tree_map(
-            lambda leaf: rep if leaf.ndim == 0 else None, opt_state_template)
-        # graft per-param shardings into m/v-like subtrees by structure match
-        def fill(sh_leaf, tmpl_leaf, path_cache={}):
-            return sh_leaf
-
-        # build opt sharding tree: scalars replicated, param-shaped leaves follow zero spec
         flat_opt, opt_def = jax.tree_util.tree_flatten(opt_state_template)
-        flat_param_sh = jax.tree_util.tree_leaves(opt_leaf_sh)
-        param_shapes = [tuple(l.shape) for l in jax.tree_util.tree_leaves(params_template)]
-        sh_by_shape = {}
-        for shp, sh in zip(param_shapes, flat_param_sh):
-            sh_by_shape.setdefault(shp, sh)
-        opt_sh_flat = []
-        for leaf in flat_opt:
-            if leaf.ndim == 0:
-                opt_sh_flat.append(rep)
-            else:
-                opt_sh_flat.append(sh_by_shape.get(tuple(leaf.shape), rep))
+        if hasattr(self.optimizer, "state_spec"):
+            # optimizer declares its state layout in terms of param specs
+            # (None = replicated scalar) — exact per-param mapping
+            spec_tree = self.optimizer.state_spec(zero_spec)
+            spec_flat = jax.tree_util.tree_flatten(
+                spec_tree, is_leaf=lambda x: x is None or isinstance(x, P))[0]
+            assert len(spec_flat) == len(flat_opt), \
+                f"optimizer state_spec leaves ({len(spec_flat)}) != state " \
+                f"leaves ({len(flat_opt)})"
+            opt_sh_flat = [rep if s is None else NamedSharding(mesh, s)
+                           for s in spec_flat]
+        else:
+            # client optimizer fallback: scalars replicated, param-shaped
+            # leaves take the spec of the first same-shaped param
+            # (approximate — same-shaped params with different TP layouts
+            # may be mis-matched; implement state_spec() for exactness)
+            flat_param_sh = jax.tree_util.tree_leaves(ns(zero_spec))
+            param_shapes = [tuple(l.shape)
+                            for l in jax.tree_util.tree_leaves(params_template)]
+            sh_by_shape = {}
+            for shp, sh in zip(param_shapes, flat_param_sh):
+                sh_by_shape.setdefault(shp, sh)
+            opt_sh_flat = [rep if leaf.ndim == 0
+                           else sh_by_shape.get(tuple(leaf.shape), rep)
+                           for leaf in flat_opt]
         opt_sh = opt_def.unflatten(opt_sh_flat)
 
         self._shardings = TrainState(
@@ -491,31 +481,32 @@ class DeepSpeedEngine:
                 lambda l: l.astype(jnp.float32), self.module.init(rng, b))
             return params_f32
 
-        init_jit = jax.jit(init_fn,
-                           out_shardings=master_sh if self.mixed_precision else param_sh)
-        params_f32 = init_jit(init_rng, dev_batch)
+        with jax.set_mesh(self.mesh):
+            init_jit = jax.jit(init_fn,
+                               out_shardings=master_sh if self.mixed_precision else param_sh)
+            params_f32 = init_jit(init_rng, dev_batch)
 
-        if self.mixed_precision:
-            cast_jit = jax.jit(
+            if self.mixed_precision:
+                cast_jit = jax.jit(
+                    lambda p: jax.tree_util.tree_map(
+                        lambda l: l.astype(self.compute_dtype), p),
+                    out_shardings=param_sh)
+                params = cast_jit(params_f32)
+                master = params_f32
+            else:
+                params = params_f32
+                master = None
+
+            opt_init_jit = jax.jit(self.optimizer.init_state,
+                                   out_shardings=self._shardings.opt_state)
+            opt_state = opt_init_jit(master if self.mixed_precision else params)
+
+            accum_template = master if self.mixed_precision else params
+            accum_jit = jax.jit(
                 lambda p: jax.tree_util.tree_map(
-                    lambda l: l.astype(self.compute_dtype), p),
-                out_shardings=param_sh)
-            params = cast_jit(params_f32)
-            master = params_f32
-        else:
-            params = params_f32
-            master = None
-
-        opt_init_jit = jax.jit(self.optimizer.init_state,
-                               out_shardings=self._shardings.opt_state)
-        opt_state = opt_init_jit(master if self.mixed_precision else params)
-
-        accum_template = master if self.mixed_precision else params
-        accum_jit = jax.jit(
-            lambda p: jax.tree_util.tree_map(
-                lambda l: jnp.zeros(l.shape, jnp.float32), p),
-            out_shardings=self._shardings.accum)
-        accum = accum_jit(accum_template)
+                    lambda l: jnp.zeros(l.shape, jnp.float32), p),
+                out_shardings=self._shardings.accum)
+            accum = accum_jit(accum_template)
 
         scaler = None
         if self._use_loss_scaler():
@@ -687,7 +678,10 @@ class DeepSpeedEngine:
         self._ensure_state(batch)
         self._compile()
         dev_batch = self._shard_batch(batch)
-        new_state, loss = self._jit_micro(self.state, dev_batch)
+        import jax
+
+        with jax.set_mesh(self.mesh):
+            new_state, loss = self._jit_micro(self.state, dev_batch)
         # torch-parity semantics: gradients only land when backward() commits
         # the staged state; a forward without backward contributes nothing.
         self._pending_state = new_state
@@ -732,23 +726,22 @@ class DeepSpeedEngine:
 
     def _take_model_step(self):
         lr = self._advance_lr()
+        import jax
         import jax.numpy as jnp
 
-        new_state, metrics = self._jit_apply(self.state, jnp.float32(lr))
+        with jax.set_mesh(self.mesh):
+            new_state, metrics = self._jit_apply(self.state, jnp.float32(lr))
         self.state = new_state
         self.global_steps += 1
         self._last_metrics = metrics
         self._last_grad_norm = metrics["grad_norm"]
-        if bool(metrics["overflow"]):
-            self.skipped_steps += 1
-            log_dist(f"OVERFLOW! Skipping step. loss scale -> "
-                     f"{float(self.state.scaler.loss_scale) if self.state.scaler else 1}",
-                     ranks=[0])
+        # skipped_steps tracked on-device (state.skipped_steps) and synced
+        # lazily — a per-step bool() here would serialize host and device
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps)
-        self._write_monitor({"lr": lr,
-                             "loss_scale": float(metrics["loss_scale"]),
-                             "grad_norm": float(metrics["grad_norm"])})
+            self._write_monitor({"lr": lr,
+                                 "loss_scale": float(metrics["loss_scale"]),
+                                 "grad_norm": float(metrics["grad_norm"])})
 
     def _advance_lr(self):
         if self.lr_scheduler is not None:
@@ -767,10 +760,12 @@ class DeepSpeedEngine:
         self._compile()
         dev = self._shard_stacked_batch(batch)
         lr = self._advance_lr()
+        import jax
         import jax.numpy as jnp
 
         self.tput_timer.start()
-        new_state, metrics = self._jit_fused(self.state, dev, jnp.float32(lr))
+        with jax.set_mesh(self.mesh):
+            new_state, metrics = self._jit_fused(self.state, dev, jnp.float32(lr))
         self.state = new_state
         self.global_steps += 1
         self.micro_steps += gas
@@ -793,7 +788,8 @@ class DeepSpeedEngine:
                 return loss
 
             self._jit_eval = jax.jit(ev)
-        return self._jit_eval(self.state, self._shard_batch(batch))
+        with jax.set_mesh(self.mesh):
+            return self._jit_eval(self.state, self._shard_batch(batch))
 
     def _shard_stacked_batch(self, batch):
         """Batch with leading (gas, batch...) dims: shard dim1 over data."""
@@ -839,8 +835,18 @@ class DeepSpeedEngine:
         path = os.path.join(save_dir, str(tag))
         os.makedirs(path, exist_ok=True)
 
+        state = self.state
+        if jax.process_count() > 1:
+            # cross-host shards are not addressable from process 0; ALL
+            # processes reshard to replicated (collective) before the write
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(self.mesh, P())
+            rep_tree = jax.tree_util.tree_map(lambda _: rep, state)
+            with jax.set_mesh(self.mesh):
+                state = jax.jit(lambda s: s, out_shardings=rep_tree)(state)
         if jax.process_index() == 0:
-            host_state = jax.device_get(self.state)
+            host_state = jax.device_get(state)
             flat, treedef = jax.tree_util.tree_flatten(host_state)
             np.savez(os.path.join(path, "model_states.npz"),
                      **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(flat)})
@@ -892,7 +898,7 @@ class DeepSpeedEngine:
 
         self.global_steps = meta["global_steps"]
         self.micro_steps = meta["micro_steps"]
-        self.skipped_steps = meta["skipped_steps"]
+        # skipped_steps restores with the device state (a TrainState leaf)
         if load_lr_scheduler_states and self.lr_scheduler is not None \
                 and meta.get("lr_scheduler") is not None:
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])

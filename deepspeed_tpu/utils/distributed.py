@@ -25,6 +25,7 @@ def init_distributed(dist_backend=None, auto_mpi_discovery=True,
     global _initialized
     if _initialized:
         return
+    ensure_platform()
     import jax
 
     required = ["MASTER_ADDR", "RANK", "WORLD_SIZE"]
@@ -53,6 +54,27 @@ def init_distributed(dist_backend=None, auto_mpi_discovery=True,
         if verbose:
             logger.info("Single-process run; skipping jax.distributed init")
     _initialized = True
+
+
+def ensure_platform():
+    """Make JAX_PLATFORMS authoritative.  Installed TPU plugins (e.g. the
+    axon tunnel) prepend themselves to jax_platforms even when the user
+    exported JAX_PLATFORMS=cpu; re-assert the env choice via jax.config
+    before the backend initializes (no-op afterwards)."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", want)
+        flags = os.environ.get("XLA_FLAGS", "")
+        key = "xla_force_host_platform_device_count="
+        if want == "cpu" and key in flags:
+            n = int(flags.split(key)[1].split()[0])
+            jax.config.update("jax_num_cpu_devices", n)
+    except Exception as e:  # backend already initialized with another platform
+        logger.warning(f"could not apply JAX_PLATFORMS={want}: {e}")
 
 
 def in_mpi_environment() -> bool:

@@ -62,7 +62,8 @@ def main():
 
     batch = make_batch()
     t0 = time.time()
-    for _ in range(args.warmup):
+    loss = engine.train_batch(batch=batch)  # always ≥1 step so compile happens
+    for _ in range(max(0, args.warmup - 1)):
         loss = engine.train_batch(batch=batch)
     # NOTE: device_get (not block_until_ready) — the axon remote-TPU backend
     # returns from block_until_ready before execution finishes; only a real

@@ -1,0 +1,125 @@
+"""1-bit Adam — communication-compressed Adam for TPU.
+
+Reference behavior (deepspeed/runtime/fp16/onebit_adam.py:18-374):
+- warmup (step < freeze_step): exact Adam *without* bias correction
+  (update = m / (sqrt(v) + eps), onebit_adam.py:325-327);
+- after freeze_step: the variance v is FROZEN; only the momentum m is
+  updated and synchronized via the error-compensated 1-bit allreduce
+  (onebit_adam.py:330-349), cutting gradient-sync traffic ~32x.
+
+TPU-native formulation: in the engine's SPMD flow gradients arrive already
+mesh-averaged (XLA reduce-scatter over 'data'), so the per-worker and server
+compression stages collapse into `quantize_with_error_feedback` — the same
+two-stage residual numerics with identical input on every worker. The real
+multi-device collective (`compressed_allreduce`, bit-packed all_to_all +
+all_gather over a named axis) lives in runtime/custom_collectives.py for
+shard_map-driven comm-bound setups (DCN-connected pods).
+"""
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.custom_collectives import (
+    compressed_allreduce, quantize_with_error_feedback)
+
+
+class OnebitAdamState(NamedTuple):
+    step: object           # i32
+    m: object              # momentum pytree, fp32
+    v: object              # variance pytree, fp32 (frozen after freeze_step)
+    worker_error: object   # error-feedback residual pytree (worker stage)
+    server_error: object   # error-feedback residual pytree (server stage)
+
+
+class OnebitAdam:
+    name = "onebitadam"
+
+    def __init__(self, lr=1e-3, freeze_step=100000, betas=(0.9, 0.999),
+                 eps=1e-8, weight_decay=0.0, max_grad_norm=0.0,
+                 bias_correction=True, amsgrad=False, cuda_aware=False,
+                 eps_inside_sqrt=False, mesh=None, axis_name=None,
+                 axis_size=1):
+        assert not amsgrad, "1-bit Adam does not support the AMSGrad variant."
+        self.lr = lr
+        self.freeze_step = freeze_step
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.mesh = mesh
+        # when set, update() runs under shard_map with this axis bound and
+        # uses the true bit-packed collective instead of local quantization;
+        # axis_size is needed at trace time to pad leaves (the reference's
+        # corrected_tensor_size, onebit_adam.py:293-298)
+        self.axis_name = axis_name
+        self.axis_size = axis_size
+
+    def init_state(self, master_params) -> OnebitAdamState:
+        zeros = lambda: jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), master_params)
+        return OnebitAdamState(step=jnp.int32(0), m=zeros(), v=zeros(),
+                               worker_error=zeros(), server_error=zeros())
+
+    def update(self, grads, state: OnebitAdamState, master_params, lr=None,
+               scale=1.0):
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+        b1, b2 = self.beta1, self.beta2
+        frozen = step > self.freeze_step  # variance freezes after warmup
+
+        def leaf(g, m, v, we, se, p):
+            g = g.astype(jnp.float32) / scale
+
+            def compressed(_):
+                m_new = b1 * m + (1.0 - b1) * g
+                flat = m_new.reshape(-1)
+                fwe, fse = we.reshape(-1), se.reshape(-1)
+                if self.axis_name is not None:
+                    quantum = 8 * self.axis_size
+                    pad = (-flat.size) % quantum
+                    q, we_new, se_new = compressed_allreduce(
+                        jnp.pad(flat, (0, pad)), jnp.pad(fwe, (0, pad)),
+                        jnp.pad(fse, (0, pad)), self.axis_name)
+                    q, we_new, se_new = (t[:flat.size]
+                                         for t in (q, we_new, se_new))
+                else:
+                    q, we_new, se_new = quantize_with_error_feedback(
+                        flat, fwe, fse)
+                return (q.reshape(m.shape), v,
+                        we_new.reshape(we.shape), se_new.reshape(se.shape))
+
+            def warmup(_):
+                # warmup parity: reference runs exact all-reduced Adam before
+                # freeze (onebit_adam.py:321-327); after freeze the compressed
+                # branch carries local momenta instead
+                g_sync = jax.lax.pmean(g, self.axis_name) \
+                    if self.axis_name is not None else g
+                m_warm = b1 * m + (1.0 - b1) * g_sync
+                v_warm = b2 * v + (1.0 - b2) * jnp.square(g_sync)
+                return m_warm, v_warm, we, se
+
+            # lax.cond so warmup steps skip the quantization (and its
+            # collectives) entirely instead of computing-and-discarding
+            m_out, v_out, we_out, se_out = jax.lax.cond(
+                frozen, compressed, warmup, None)
+
+            update = m_out / (jnp.sqrt(v_out) + self.eps)
+            if self.weight_decay > 0.0:
+                update = update + self.weight_decay * p
+            return p - lr * update, m_out, v_out, we_out, se_out
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat = lambda t: jax.tree_util.tree_leaves(t)
+        outs = [leaf(g, m, v, we, se, p) for g, m, v, we, se, p in
+                zip(flat_g, flat(state.m), flat(state.v),
+                    flat(state.worker_error), flat(state.server_error),
+                    flat(master_params))]
+        unf = treedef.unflatten
+        new_p, new_m, new_v, new_we, new_se = (unf(list(t)) for t in zip(*outs))
+        return new_p, OnebitAdamState(step=step, m=new_m, v=new_v,
+                                      worker_error=new_we, server_error=new_se)
+
+    def state_spec(self, param_specs):
+        return OnebitAdamState(step=None, m=param_specs, v=param_specs,
+                               worker_error=param_specs,
+                               server_error=param_specs)

@@ -326,6 +326,11 @@ def flash_attention(q, k, v, *, causal: bool = False,
         interpret = _interpret_default()
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
+    # kernel causal mask is top-left aligned (q_idx >= k_idx from 0); with
+    # s_q != s_k that diverges from bottom-right-aligned decode semantics
+    assert not causal or s_q == s_k, (
+        f"causal flash attention requires equal q/k lengths, got ({s_q}, {s_k}); "
+        f"use the jnp path for cross-length (decode) attention")
     assert s_q % min(block_q, s_q) == 0 and s_k % min(block_k, s_k) == 0, (
         f"seq lengths ({s_q}, {s_k}) must divide into blocks "
         f"({block_q}, {block_k}); pad the sequence or use the jnp path — "

@@ -1,0 +1,108 @@
+"""Error-compensated compressed collectives — TPU-native 1-bit allreduce.
+
+Reference behavior (deepspeed/runtime/fp16/onebit_adam.py:104-228 +
+runtime/custom_collectives.py:10-152): each worker adds its error-feedback
+residual, sign-compresses (scale = ||x||_2/sqrt(n), sign with 0 -> +1),
+scatters chunk j to "server" j; each server averages the w compressed chunks,
+re-compresses with its own residual, and all-gathers the result.
+
+Here the same two-phase scheme runs *inside one jitted step* over a named mesh
+axis: `lax.all_to_all` is the worker->server scatter-gather, `lax.all_gather`
+broadcasts the server result, and signs travel bit-packed in uint8 (32x less
+traffic than fp32 — the same wire format the reference gets from
+cupy.packbits). mpi4py/cupy stream juggling disappears; XLA schedules the
+collectives on ICI/DCN.
+"""
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_POW2 = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], dtype=jnp.uint8)
+
+
+def pack_signs(signs):
+    """{-1,+1} float vector (len % 8 == 0) -> uint8 bit-packed vector."""
+    bits = (signs > 0).astype(jnp.uint8).reshape(-1, 8)
+    return (bits * _POW2[None, :]).sum(-1).astype(jnp.uint8)
+
+
+def unpack_signs(packed):
+    """uint8 bit-packed vector -> {-1,+1} float32 vector."""
+    bits = (packed[:, None] // _POW2[None, :]) % 2
+    return (bits.astype(jnp.float32) * 2.0 - 1.0).reshape(-1)
+
+
+def _sign_compress(x):
+    """Returns (scale, signs, residual): x ~= scale*signs, residual = x - that.
+
+    scale = ||x||_2 / sqrt(n) (reference onebit_adam.py:123); sign(0) -> +1
+    (the reference's sign().add_(1).bool() mapping, onebit_adam.py:124-127).
+    """
+    scale = jnp.linalg.norm(x) / jnp.sqrt(jnp.float32(x.size))
+    signs = jnp.where(x >= 0, 1.0, -1.0).astype(jnp.float32)
+    return scale, signs, x - scale * signs
+
+
+def compressed_allreduce(x, worker_error, server_error, axis_name):
+    """Error-compensated 1-bit average of per-device `x` over `axis_name`.
+
+    Must be called inside shard_map/pmap with `axis_name` bound. `x` is the
+    device-local flat fp32 tensor, length divisible by 8*axis_size; ``x.size
+    == worker_error.size``; ``server_error`` is either chunk-sized
+    (x.size // axis_size, this device's server residual) or full-sized
+    (x.size — this device's chunk is sliced at axis_index and written back,
+    so optimizer state stays param-shaped).
+
+    Returns (averaged_x, new_worker_error, new_server_error).
+    """
+    w = lax.axis_size(axis_name)
+    n = x.size
+    assert n % (8 * w) == 0, f"compressed_allreduce needs size % {8*w} == 0, got {n}"
+    full_server_error = server_error.size == n
+    if full_server_error:
+        idx = lax.axis_index(axis_name)
+        server_error_full = server_error
+        server_error = lax.dynamic_slice(server_error, (idx * (n // w),),
+                                         (n // w,))
+
+    # --- worker phase: compensate, compress, scatter chunks to servers ----
+    buf = x + worker_error
+    worker_scale, signs, new_worker_error = _sign_compress(buf)
+    packed = pack_signs(signs).reshape(w, n // (8 * w))
+    # chunk j of every worker lands on device j: rows = per-worker signs of my chunk
+    recv = lax.all_to_all(packed, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)
+    scales = lax.all_gather(worker_scale, axis_name)             # (w,)
+    if recv.ndim == 1:  # w == 1 keeps the row dim collapsed
+        recv = recv.reshape(w, -1)
+    worker_signs = unpack_signs(recv.reshape(-1)).reshape(w, n // w)
+
+    # --- server phase: average, re-compress with server residual ---------
+    server_m = (worker_signs * scales[:, None]).sum(0) / w + server_error
+    server_scale, server_signs, new_server_error = _sign_compress(server_m)
+    server_packed = pack_signs(server_signs)
+
+    # --- broadcast: all-gather every server's compressed chunk -----------
+    all_packed = lax.all_gather(server_packed, axis_name)        # (w, n/8w)
+    all_scales = lax.all_gather(server_scale, axis_name)         # (w,)
+    out_signs = unpack_signs(all_packed.reshape(-1)).reshape(w, n // w)
+    out = (out_signs * all_scales[:, None]).reshape(-1)
+    if full_server_error:
+        new_server_error = lax.dynamic_update_slice(
+            server_error_full, new_server_error, (idx * (n // w),))
+    return out, new_worker_error, new_server_error
+
+
+def quantize_with_error_feedback(x, worker_error, server_error):
+    """Single-device equivalent of compressed_allreduce (w == 1): two
+    sequential sign-compressions with persistent residuals.
+
+    Used by OnebitAdam when gradients are already mesh-averaged (the engine's
+    SPMD flow): the quantization numerics — including both error-feedback
+    stages — match the distributed scheme with identical per-worker input.
+    """
+    buf = x + worker_error
+    worker_scale, signs, new_worker_error = _sign_compress(buf)
+    server_m = worker_scale * signs + server_error
+    server_scale, server_signs, new_server_error = _sign_compress(server_m)
+    return server_scale * server_signs, new_worker_error, new_server_error

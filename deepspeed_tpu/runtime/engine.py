@@ -735,8 +735,16 @@ class DeepSpeedEngine:
         self.global_steps += 1
         self._last_metrics = metrics
         self._last_grad_norm = metrics["grad_norm"]
-        # skipped_steps tracked on-device (state.skipped_steps) and synced
-        # lazily — a per-step bool() here would serialize host and device
+        if self.fp16_enabled():
+            # overflow must be visible when it happens (reference
+            # fused_optimizer.py logs every skipped step); one small scalar
+            # fetch on the already-host-driven non-fused path
+            if bool(jax.device_get(metrics["overflow"])):
+                log_dist(
+                    f"OVERFLOW! Skipping step {self.global_steps}; "
+                    f"reducing loss scale to "
+                    f"{float(jax.device_get(new_state.scaler.loss_scale)):g}",
+                    ranks=[0])
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps)
             self._write_monitor({"lr": lr,

@@ -1,0 +1,77 @@
+"""GPT-2 as a PipelineModule — the flagship model in pipeline form.
+
+Mirrors the reference's Megatron-GPT2 pipeline configs
+(tests/model/Megatron_GPT2/run_perf_test.py:18-84: e.g. 1.5B = 48L/1600h on
+16 GPUs with mp2/mp4) expressed as LayerSpecs: embedding -> n_layer blocks ->
+final LN -> tied LM head (TiedLayerSpec reusing the embedding matrix, the
+reference's canonical tied-weight example, module.py:71-83).
+"""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.api import cross_entropy_loss
+from deepspeed_tpu.models.gpt2 import Block, GPT2Config
+from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                               TiedLayerSpec)
+
+
+class GPT2Embed(nn.Module):
+    """Token + position embeddings; owns the tied wte matrix."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = False):
+        cfg = self.config
+        S = input_ids.shape[1]
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.vocab_size, cfg.n_embd), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (cfg.n_positions, cfg.n_embd), jnp.float32)
+        x = wte.astype(cfg.dtype)[input_ids] + wpe.astype(cfg.dtype)[None, :S]
+        if train and cfg.dropout > 0:
+            x = nn.Dropout(cfg.dropout)(x, deterministic=False)
+        return x
+
+
+class GPT2BlockLayer(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return Block(self.config, name="block")(x, train)
+
+
+class GPT2FinalNorm(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.LayerNorm(epsilon=self.config.layer_norm_epsilon,
+                            dtype=self.config.dtype, name="ln_f")(x)
+
+
+def _tied_lm_head(module, params, x):
+    """forward_fn for the tied head: logits against the shared wte."""
+    wte = params["wte"]
+    return jnp.einsum("bse,ve->bsv", x, wte.astype(x.dtype))
+
+
+def gpt2_pipeline_module(config: GPT2Config, partition_method="parameters",
+                         activation_checkpoint_interval=0):
+    """Build the LayerSpec pipeline for a GPT-2 config."""
+    specs = [TiedLayerSpec("embed", GPT2Embed, config)]
+    for _ in range(config.n_layer):
+        specs.append(LayerSpec(GPT2BlockLayer, config))
+    specs.append(LayerSpec(GPT2FinalNorm, config))
+    specs.append(TiedLayerSpec("embed", GPT2Embed, config,
+                               forward_fn=_tied_lm_head))
+
+    def loss_fn(logits, batch):
+        return cross_entropy_loss(logits[:, :-1], batch["labels"][:, 1:],
+                                  ignore_index=-100)
+
+    return PipelineModule(
+        specs, loss_fn=loss_fn, partition_method=partition_method,
+        input_fn=lambda batch: batch["input_ids"],
+        activation_checkpoint_interval=activation_checkpoint_interval)

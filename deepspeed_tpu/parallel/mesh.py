@@ -36,7 +36,8 @@ def resolve_mesh_shape(mesh_shape: dict, n_devices: int):
             f"{n_devices} devices not divisible by fixed axes product {fixed}"
         shape[free_axes[0]] = n_devices // fixed
     total = shape[PIPE_AXIS] * shape[DATA_AXIS] * shape[MODEL_AXIS]
-    assert total == n_devices, \
+    # a fully-specified mesh may use a subset of devices (tests, partial pods)
+    assert total <= n_devices, \
         f"mesh {shape} needs {total} devices but {n_devices} available"
     return shape
 
@@ -52,7 +53,15 @@ def build_mesh(mesh_shape: Optional[dict] = None, devices=None):
     if devices is None:
         devices = jax.devices()
     shape = resolve_mesh_shape(mesh_shape or {}, len(devices))
-    dev_array = np.asarray(devices).reshape(
+    total = shape[PIPE_AXIS] * shape[DATA_AXIS] * shape[MODEL_AXIS]
+    if total < len(devices):
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.warning(
+            f"mesh {shape} uses {total} of {len(devices)} devices — "
+            f"{len(devices) - total} idle (intended for tests/partial "
+            f"slices; check the config's mesh axes if not)")
+    dev_array = np.asarray(devices[:total]).reshape(
         shape[PIPE_AXIS], shape[DATA_AXIS], shape[MODEL_AXIS])
     return Mesh(dev_array, AXIS_ORDER)
 

@@ -1,0 +1,94 @@
+"""Ring attention — sequence parallelism over the ICI ring.
+
+The reference has NO sequence-dimension parallelism (SURVEY §2.9: long
+sequences are handled only by block-sparse attention compute sparsity). On
+TPU, sequence parallelism is first-class: activations are sharded over the
+sequence dimension across a named mesh axis, and attention runs blockwise
+while K/V shards rotate around the ring via `lax.ppermute` — each hop
+overlaps with the matmuls of the current block (XLA's latency-hiding
+scheduler), so the attention memory per chip is O(S/N) with no materialized
+S x S matrix. Algorithm: blockwise online softmax (the flash-attention
+recurrence) with cross-device blocks — Liu et al. 2023 "Ring Attention with
+Blockwise Transformers" (PAPERS.md).
+
+Differentiable: the ppermute rotations are linear, jax.grad produces the
+reverse-ring backward automatically.
+"""
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _ring_attention_local(q, k, v, axis_name, causal, scale):
+    """Per-device body: q,k,v are (B, H, S_local, D) shards, sequence
+    sharded over `axis_name`. Must run inside shard_map with the axis bound.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    scale = (D ** -0.5) if scale is None else scale
+    q32 = q.astype(jnp.float32) * scale
+
+    q_pos = idx * S + jnp.arange(S)                      # global query positions
+
+    def round_body(r, carry):
+        m, l, acc, k_blk, v_blk = carry
+        # the block we hold at round r originated from rank (idx - r) mod n
+        src = (idx - r) % n
+        k_pos = src * S + jnp.arange(S)
+
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk.astype(jnp.float32))
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]      # (S, S) block mask
+            s = jnp.where(mask[None, None], s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * correction + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+
+        # rotate K/V shards one hop around the ring
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return m_new, l_new, acc_new, k_blk, v_blk
+
+    # pvary: the accumulators become device-varying over the ring axis after
+    # the first round; the loop carry type must declare that up front
+    m0 = lax.pcast(jnp.full((B, H, S, 1), NEG_INF, jnp.float32), (axis_name,), to='varying')
+    l0 = lax.pcast(jnp.zeros((B, H, S, 1), jnp.float32), (axis_name,), to='varying')
+    acc0 = lax.pcast(jnp.zeros((B, H, S, D), jnp.float32), (axis_name,), to='varying')
+    m, l, acc, _, _ = lax.fori_loop(0, n, round_body, (m0, l0, acc0, k, v))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l_safe).astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
+                   scale: Optional[float] = None):
+    """Sequence-parallel attention. Call inside shard_map/jit where
+    `axis_name` is a manual mesh axis and q/k/v are the device-local
+    (B, H, S/N, D) shards of sequence-sharded tensors."""
+    return _ring_attention_local(q, k, v, axis_name, causal, scale)
+
+
+def make_ring_attention(mesh, axis_name: str, causal: bool = True,
+                        scale: Optional[float] = None):
+    """shard_map-wrapped ring attention over full (B, H, S, D) arrays with
+    the sequence dim sharded over `axis_name` — drop-in replacement for
+    dense attention inside a jitted step (a shard_map island; everything
+    around it stays GSPMD-auto)."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, None, axis_name, None)
+    fn = functools.partial(_ring_attention_local, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={axis_name})

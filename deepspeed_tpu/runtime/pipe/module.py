@@ -1,21 +1,46 @@
-"""PipelineModule — placeholder until the pipeline engine lands.
+"""PipelineModule — express a model as a partitionable layer list.
 
-Real implementation: LayerSpec/TiedLayerSpec partitioning over pipe stages
-(reference: deepspeed/runtime/pipe/module.py:85).
+Reference behavior: deepspeed/runtime/pipe/module.py:23-575 (LayerSpec lazy
+construction, TiedLayerSpec shared weights, uniform/parameters/type:regex
+stage partitioning, per-layer seeds, activation checkpointing every N layers).
+
+TPU-first formulation: the module is functional — it produces a params pytree
+keyed per layer ("layer_00", ..., tied params under "tied_<key>") and pure
+apply functions per stage. The same object serves three executors:
+- the base DeepSpeedEngine (sequential apply -> the DataParallelSchedule
+  baseline, and the parity reference for pipeline tests),
+- the PipelineEngine (per-stage apply on stage submeshes),
+- user code (module.forward_stage for custom drivers).
 """
+import re
+
+from deepspeed_tpu.runtime.utils import partition_balanced, partition_uniform
+from deepspeed_tpu.utils.logging import logger
 
 
 class LayerSpec:
+    """Lazily-built layer: stores the constructor + args so stages only pay
+    for what they build (reference module.py:23-68)."""
+
     def __init__(self, typename, *module_args, **module_kwargs):
         self.typename = typename
         self.module_args = module_args
         self.module_kwargs = module_kwargs
 
-    def build(self):
+    def build(self, log=False):
+        if log:
+            logger.info(f"building {repr(self)}")
         return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        name = getattr(self.typename, "__name__", str(self.typename))
+        return f"LayerSpec({name})"
 
 
 class TiedLayerSpec(LayerSpec):
+    """Layer whose params are shared with every other spec carrying the same
+    key — e.g. embedding reused as the LM head (reference module.py:71-83)."""
+
     def __init__(self, key, typename, *module_args, forward_fn=None,
                  tied_weight_attr="embedding", **module_kwargs):
         super().__init__(typename, *module_args, **module_kwargs)
@@ -24,10 +49,256 @@ class TiedLayerSpec(LayerSpec):
         self.tied_weight_attr = tied_weight_attr
 
 
+def _is_flax_module(obj):
+    try:
+        import flax.linen as nn
+
+        return isinstance(obj, nn.Module)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+class _Layer:
+    """Uniform init/apply wrapper over flax modules and plain callables."""
+
+    def __init__(self, obj, index, param_key, forward_fn=None):
+        import inspect
+
+        self.obj = obj
+        self.index = index
+        self.param_key = param_key        # None => stateless
+        self.forward_fn = forward_fn
+        self.is_flax = _is_flax_module(obj)
+        self.type_name = type(obj).__name__
+        self.tied_key = None
+        self.is_tied_owner = False
+        # inspect once instead of catching TypeError per call — a retry
+        # would silently swallow genuine TypeErrors from the train path
+        self.accepts_train = False
+        if self.is_flax:
+            try:
+                sig = inspect.signature(type(obj).__call__)
+                self.accepts_train = "train" in sig.parameters
+            except (TypeError, ValueError):  # pragma: no cover
+                pass
+
+    def _flax_apply(self, params, x, rng, train):
+        kwargs = {"train": train} if self.accepts_train else {}
+        return self.obj.apply({"params": params}, x,
+                              rngs={"dropout": rng}, **kwargs)
+
+    def init(self, rng, x):
+        if self.is_flax:
+            kwargs = {"train": False} if self.accepts_train else {}
+            variables = self.obj.init({"params": rng, "dropout": rng}, x,
+                                      **kwargs)
+            params = variables.get("params", {})
+            return params, self._flax_apply(params, x, rng, train=False)
+        # stateless callable
+        return None, self.obj(x)
+
+    def apply(self, params, x, rng, train):
+        if self.forward_fn is not None:
+            return self.forward_fn(self.obj, params, x)
+        if self.is_flax:
+            return self._flax_apply(params, x, rng, train)
+        return self.obj(x)
+
+
 class PipelineModule:
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "PipelineModule is implemented in the pipeline milestone")
+    """Layer-list model, partitionable across pipeline stages.
+
+    Args:
+        layers: sequence of LayerSpec / TiedLayerSpec / flax modules /
+            callables, applied in order.
+        loss_fn: (final_output, batch) -> (scalar_loss, metrics dict).
+        num_stages: pipeline depth (defaults to the mesh 'pipe' axis when
+            driven by an engine; 1 otherwise).
+        partition_method: 'uniform' | 'parameters' | 'type:<regex>'
+            (reference module.py:348-403).
+        input_fn: batch -> first-stage input (default: batch['x']).
+        activation_checkpoint_interval: remat every N layers in the
+            sequential path (reference module.py:292-346).
+        seed_layers: give each layer a distinct fold_in seed
+            (reference module.py:85 seed_layers).
+    """
+
+    def __init__(self, layers, loss_fn=None, num_stages=None, topology=None,
+                 partition_method="parameters", input_fn=None,
+                 activation_checkpoint_interval=0, seed_layers=False,
+                 base_seed=1234):
+        self.specs = list(layers)
+        self.loss_fn = loss_fn
+        self.num_stages = num_stages
+        self.client_topology = topology
+        self.partition_method = partition_method
+        self.input_fn = input_fn or (lambda batch: batch["x"])
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+
+        self._layers = []
+        tied_owner = {}
+        for i, spec in enumerate(self.specs):
+            if isinstance(spec, TiedLayerSpec):
+                layer = _Layer(spec.build(), i, f"tied_{spec.key}",
+                               spec.forward_fn)
+                layer.tied_key = spec.key
+                if spec.key not in tied_owner:
+                    tied_owner[spec.key] = i
+                layer.is_tied_owner = tied_owner[spec.key] == i
+            elif isinstance(spec, LayerSpec):
+                layer = _Layer(spec.build(), i, f"layer_{i:02d}")
+            else:
+                layer = _Layer(spec, i,
+                               f"layer_{i:02d}" if _is_flax_module(spec)
+                               else None)
+            self._layers.append(layer)
+        self._param_counts = None   # per-layer param count, set by init
+        self._parts = None          # stage boundaries, lazy
+
+    # ------------------------------------------------------------------
+    # engine model contract
+    # ------------------------------------------------------------------
+    def init(self, rng, batch):
+        import jax
+
+        params = {}
+        x = self.input_fn(batch)
+        counts = []
+        for layer in self._layers:
+            lrng = jax.random.fold_in(rng, layer.index if self.seed_layers else 0)
+            if layer.param_key is not None and layer.param_key in params:
+                # tied reuse: params exist; just advance the activation
+                x = layer.apply(params[layer.param_key], x, lrng, train=False)
+                counts.append(0)
+                continue
+            p, x_new = layer.init(lrng, x)
+            x = x_new
+            if p is None or (hasattr(p, "__len__") and len(p) == 0):
+                layer.param_key = None
+                counts.append(0)
+            else:
+                params[layer.param_key] = p
+                counts.append(sum(int(l.size)
+                                  for l in jax.tree_util.tree_leaves(p)))
+        self._param_counts = counts
+        return params
+
+    def loss(self, params, batch, rng, train=True):
+        assert self.loss_fn is not None, "PipelineModule needs loss_fn to train"
+        out = self.forward_full(params, batch, rng, train)
+        return self.loss_fn(out, batch)
+
+    def forward_full(self, params, batch, rng, train):
+        """Sequential (non-pipelined) forward through all layers, with
+        activation checkpointing every N layers when configured."""
+        import jax
+
+        x = self.input_fn(batch)
+        interval = self.activation_checkpoint_interval
+        if interval and train:
+            for start in range(0, len(self._layers), interval):
+                seg = self._layers[start:start + interval]
+
+                def run(x, seg=seg):
+                    return self._apply_range(params, x, rng, train, seg)
+
+                x = jax.checkpoint(run)(x)
+            return x
+        return self._apply_range(params, x, rng, train, self._layers)
+
+    def _apply_range(self, params, x, rng, train, layers):
+        import jax
+
+        for layer in layers:
+            lrng = jax.random.fold_in(rng, layer.index if self.seed_layers else 0)
+            p = params[layer.param_key] if layer.param_key is not None else None
+            x = layer.apply(p, x, lrng, train)
+        return x
+
+    def forward_stage(self, params, x, stage_id, rng, train, num_stages=None):
+        """Apply this stage's layer range to x (PipelineEngine hot path)."""
+        start, stop = self.stage_bounds(stage_id, num_stages)
+        return self._apply_range(params, x, rng, train,
+                                 self._layers[start:stop])
+
+    # ------------------------------------------------------------------
+    # partitioning (reference module.py:348-403)
+    # ------------------------------------------------------------------
+    def stage_bounds(self, stage_id, num_stages=None):
+        parts = self.partition_layers(num_stages)
+        return parts[stage_id], parts[stage_id + 1]
+
+    def partition_layers(self, num_stages=None):
+        num_stages = num_stages or self.num_stages or 1
+        if self._parts is not None and len(self._parts) == num_stages + 1:
+            return self._parts
+        n = len(self._layers)
+        method = (self.partition_method or "uniform").lower()
+        if method == "uniform":
+            parts = partition_uniform(n, num_stages)
+        elif method == "parameters":
+            assert self._param_counts is not None, \
+                "call init() before parameter-balanced partitioning"
+            # tied reuses count 0 so the owner stage carries the weight
+            parts = partition_balanced(self._param_counts, num_stages)
+        elif method.startswith("type:"):
+            pattern = method.split(":", 1)[1]
+            weights = [1 if re.search(pattern, l.type_name, re.IGNORECASE)
+                       else 0 for l in self._layers]
+            parts = partition_balanced(weights, num_stages)
+        elif method == "profile":
+            raise NotImplementedError(
+                "profile partitioning is not implemented (parity: reference "
+                "module.py:372 also raises)")
+        else:
+            raise KeyError(f"unknown partition method {self.partition_method}")
+        self._parts = parts
+        return parts
+
+    # ------------------------------------------------------------------
+    # introspection used by the engine
+    # ------------------------------------------------------------------
+    @property
+    def layers(self):
+        return self._layers
+
+    def stage_param_keys(self, stage_id, num_stages=None):
+        """Param-tree keys owned by a stage. Tied params belong to every
+        stage that uses them (the engine keeps them in sync)."""
+        start, stop = self.stage_bounds(stage_id, num_stages)
+        keys = []
+        for layer in self._layers[start:stop]:
+            if layer.param_key is not None and layer.param_key not in keys:
+                keys.append(layer.param_key)
+        return keys
+
+    def tied_groups(self, num_stages=None):
+        """{tie_key: sorted list of stage_ids using it} for multi-stage ties
+        (reference module.py:420-474)."""
+        num_stages = num_stages or self.num_stages or 1
+        groups = {}
+        for layer in self._layers:
+            if layer.tied_key is None or layer.param_key is None:
+                continue
+            for s in range(num_stages):
+                start, stop = self.stage_bounds(s, num_stages)
+                if start <= layer.index < stop:
+                    groups.setdefault(layer.tied_key, set()).add(s)
+        return {k: sorted(v) for k, v in groups.items() if len(v) > 1}
+
+    def param_partition_spec(self, params):
+        """Per-layer TP specs: replicated by default (layers may be plain)."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        return jax.tree_util.tree_map(lambda _: P(), params)
+
+    def num_params(self, params):
+        import jax
+
+        return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
 
     def mpu(self):
         return None

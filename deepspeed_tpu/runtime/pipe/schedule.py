@@ -1,0 +1,259 @@
+"""Pipeline instruction schedules — declarative streams driving the engine.
+
+Reference behavior: deepspeed/runtime/pipe/schedule.py:6-482. The schedule is
+an algorithm spec, not an implementation detail: TrainSchedule emits the
+1F1B-interleaved stream (even/odd step -> micro-batch mapping, buffer count =
+min(stages - stage + 1, micro_batches)); the TPU engine consumes it two ways:
+
+- host-driven: execute each instruction as a jitted stage call + ppermute
+  (faithful, flexible);
+- fused: the whole stream is lowered into one jitted lax.scan over
+  "pipeline clock ticks" (runtime/pipe/engine.py) — the schedule still
+  defines WHAT happens at each tick.
+"""
+
+
+class PipeInstruction:
+    """Namedtuple-style instruction; kwargs become attributes.
+    Reference: schedule.py:336-356."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v!r}" for k, v in self.kwargs.items())
+        return f"{self.name}({args})"
+
+    def __eq__(self, other):
+        return (type(self) is type(other)) and self.kwargs == other.kwargs
+
+    def __hash__(self):
+        return hash((self.name, tuple(sorted(self.kwargs.items()))))
+
+
+class OptimizerStep(PipeInstruction):
+    """Step the optimizer and zero gradients; after Reduce*Grads."""
+
+
+class ReduceGrads(PipeInstruction):
+    """Data-parallel gradient reduction within the stage."""
+
+
+class ReduceTiedGrads(PipeInstruction):
+    """All-reduce gradients of tied modules over their tie group."""
+
+
+class BufferOpInstruction(PipeInstruction):
+    def __init__(self, buffer_id, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    """Load a micro-batch into buffer_id (first/last stages only)."""
+
+
+class ForwardPass(BufferOpInstruction):
+    """Run forward on buffer_id's activations."""
+
+
+class BackwardPass(BufferOpInstruction):
+    """Run backward with buffer_id's received output grads."""
+
+
+class SendActivation(BufferOpInstruction):
+    """Send buffer_id's activations to the next stage."""
+
+
+class RecvActivation(BufferOpInstruction):
+    """Receive activations from the previous stage into buffer_id."""
+
+
+class SendGrad(BufferOpInstruction):
+    """Send buffer_id's input grads to the previous stage."""
+
+
+class RecvGrad(BufferOpInstruction):
+    """Receive output grads from the next stage into buffer_id."""
+
+
+def _even(x):
+    return x % 2 == 0
+
+
+class PipeSchedule:
+    """Generator of per-step instruction lists for one stage; each yielded
+    step is barrier-safe. Reference: schedule.py:6-127."""
+
+    def __init__(self, micro_batches, stages, stage_id):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    def steps(self):
+        raise NotImplementedError
+
+    def num_pipe_buffers(self):
+        return self.micro_batches
+
+    def _valid_micro_batch(self, mb):
+        return 0 <= mb < self.micro_batches
+
+    def _valid_stage(self, stage):
+        return 0 <= stage < self.stages
+
+    @property
+    def stage(self):
+        return self.stage_id
+
+    @property
+    def num_stages(self):
+        return self.stages
+
+    @property
+    def num_micro_batches(self):
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def _buffer_idx(self, mb):
+        assert self._valid_micro_batch(mb)
+        return mb % self.num_pipe_buffers()
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only wavefront; double-buffered. Reference: schedule.py:129-181."""
+
+    def steps(self):
+        for step_id in range(self.micro_batches + self.stages - 1):
+            mb = step_id - self.stage_id
+            cmds = []
+            if _even(self.stage_id):
+                recv_buf, send_buf = step_id % 2, (step_id + 1) % 2
+            else:
+                recv_buf, send_buf = (step_id + 1) % 2, step_id % 2
+
+            if (self.is_first_stage or self.is_last_stage) \
+                    and self._valid_micro_batch(mb):
+                cmds.append(LoadMicroBatch(recv_buf))
+
+            # even stages send-then-recv, odd stages recv-then-send, so
+            # paired blocking exchanges can't deadlock
+            def _send():
+                if self._valid_stage(self.next_stage) \
+                        and self._valid_micro_batch(mb - 1):
+                    cmds.append(SendActivation(send_buf))
+
+            def _recv():
+                if self._valid_stage(self.prev_stage) \
+                        and self._valid_micro_batch(mb):
+                    cmds.append(RecvActivation(recv_buf))
+
+            if _even(self.stage_id):
+                _send(), _recv()
+            else:
+                _recv(), _send()
+
+            if self._valid_micro_batch(mb):
+                cmds.append(ForwardPass(recv_buf))
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 2
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B-interleaved training stream. Reference: schedule.py:183-289.
+
+    Total 2*(micro_batches + stages - 1) ticks; each tick maps to a
+    (micro_batch, is_forward) pair via the even/odd parity of tick and stage,
+    interleaving one forward with one backward in steady state.
+    """
+
+    def steps(self):
+        prev_mb = -1
+        total = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total):
+            mb, is_forward = self._step_to_micro_batch(step_id)
+            cmds = []
+
+            # activation/grad exchange with the neighbor stages
+            if is_forward:
+                if self._valid_stage(self.prev_stage):
+                    if self._valid_micro_batch(mb):
+                        cmds.append(RecvActivation(self._buffer_idx(mb)))
+                    if self._valid_micro_batch(prev_mb):
+                        cmds.append(SendGrad(self._buffer_idx(prev_mb)))
+            else:
+                if self._valid_stage(self.next_stage):
+                    if self._valid_micro_batch(prev_mb):
+                        cmds.append(SendActivation(self._buffer_idx(prev_mb)))
+                    if self._valid_micro_batch(mb):
+                        cmds.append(RecvGrad(self._buffer_idx(mb)))
+
+            if (self.is_first_stage or self.is_last_stage) \
+                    and is_forward and self._valid_micro_batch(mb):
+                cmds.append(LoadMicroBatch(self._buffer_idx(mb)))
+
+            if self._valid_micro_batch(mb):
+                cmds.append(ForwardPass(self._buffer_idx(mb)) if is_forward
+                            else BackwardPass(self._buffer_idx(mb)))
+
+            if step_id == total - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+
+            prev_mb = mb
+            yield cmds
+
+    def num_pipe_buffers(self):
+        """Distance to the last stage bounds in-flight micro-batches
+        (reference schedule.py:243)."""
+        return max(2, min(self.stages - self.stage_id + 1, self.micro_batches))
+
+    def _step_to_micro_batch(self, step_id):
+        """Even ticks run forwards on even stages / backwards on odd stages,
+        and vice versa — the phase shift that interleaves 1F1B."""
+        base = step_id // 2
+        if _even(step_id) == _even(self.stage_id):
+            # forward tick for this stage
+            if _even(step_id):
+                mb = base - self.stage_id // 2
+            else:
+                mb = (step_id - 1) // 2 - self.stage_id // 2
+            return mb, True
+        # backward tick
+        if _even(step_id):
+            mb = base - self.stages + (self.stage_id + 1) // 2
+        else:
+            mb = (step_id - 1) // 2 - self.stages + 1 + self.stage_id // 2
+        return mb, False
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Plain gradient-accumulation DP expressed as a pipe schedule.
+    Reference: schedule.py:292-318."""
+
+    def steps(self):
+        for step_id in range(self.micro_batches):
+            cmds = [LoadMicroBatch(0), ForwardPass(0), BackwardPass(0)]
+            if step_id == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 1

@@ -97,3 +97,54 @@ def args_from_dict(tmpdir, config_dict):
     args.deepspeed_config = config_path
     args.local_rank = 0
     return args
+
+
+def make_stack_specs(hidden_dim, n_layers, n_classes=4, tied_head=False):
+    """Pipeline fixture: LayerSpec list for a Dense-tanh stack classifier —
+    the analog of reference LinearStackPipe (simple_model.py:27-79).
+
+    Returns (specs, loss_fn, input_fn).
+    """
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.pipe.module import LayerSpec, TiedLayerSpec
+
+    class DenseTanh(nn.Module):
+        features: int
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            return jnp.tanh(nn.Dense(self.features, name="lin")(x))
+
+    class Head(nn.Module):
+        features: int
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(self.features, name="lin")(x)
+
+    class TiedDense(nn.Module):
+        """Square layer used twice via TiedLayerSpec."""
+        features: int
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            return jnp.tanh(nn.Dense(self.features, name="lin")(x))
+
+    specs = []
+    if tied_head:
+        specs.append(TiedLayerSpec("emb", TiedDense, hidden_dim))
+    for _ in range(n_layers):
+        specs.append(LayerSpec(DenseTanh, hidden_dim))
+    if tied_head:
+        specs.append(TiedLayerSpec("emb", TiedDense, hidden_dim))
+    specs.append(LayerSpec(Head, n_classes))
+
+    def loss_fn(logits, batch):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        loss = -jnp.mean(jnp.take_along_axis(logp, batch["y"][:, None], axis=1))
+        return loss, {"loss": loss}
+
+    return specs, loss_fn, (lambda batch: batch["x"])

@@ -1,0 +1,165 @@
+"""End-to-end pipeline-parallel training tests — reference
+tests/unit/test_pipe.py pattern: train the same stack model under different
+pipe topologies and require matching losses."""
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.pipe.module import PipelineModule
+from tests.unit.simple_model import make_stack_specs, random_dataloader
+
+HIDDEN = 8
+LAYERS = 6
+MICRO = 2
+GAS = 2
+
+
+def _config(dp, pipe, extra=None):
+    cfg = {
+        "train_batch_size": MICRO * GAS * dp,
+        "train_micro_batch_size_per_gpu": MICRO,
+        "gradient_accumulation_steps": GAS,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "steps_per_print": 100,
+        "mesh": {"pipe": pipe, "data": dp, "model": 1},
+    }
+    if extra:
+        cfg.update(extra)
+    return cfg
+
+
+def _train(pipe, dp, steps=8, tied=False, seed=0, extra=None,
+           partition_method="uniform"):
+    specs, loss_fn, input_fn = make_stack_specs(HIDDEN, LAYERS,
+                                                tied_head=tied)
+    module = PipelineModule(specs, loss_fn=loss_fn, input_fn=input_fn,
+                            partition_method=partition_method)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=module, config_params=_config(dp, pipe, extra))
+    data = random_dataloader(HIDDEN, 64, MICRO * dp, seed=seed)
+    losses = [engine.train_batch(data_iter=data) for _ in range(steps)]
+    return engine, losses
+
+
+def test_pipe_1stage_trains():
+    _, losses = _train(pipe=1, dp=2, steps=10)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_pipe_2stage_trains():
+    _, losses = _train(pipe=2, dp=2, steps=10)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_pipe_4stage_matches_1stage():
+    """Same model/data/seed: pipeline depth must not change the math
+    (reference test_pipe.py:267 compares topologies within rel_diff)."""
+    _, base = _train(pipe=1, dp=2, steps=6)
+    _, pipe2 = _train(pipe=2, dp=2, steps=6)
+    _, pipe4 = _train(pipe=4, dp=2, steps=6)
+    np.testing.assert_allclose(base, pipe2, rtol=2e-4)
+    np.testing.assert_allclose(base, pipe4, rtol=2e-4)
+
+
+def test_pipe_with_data_parallel_matches():
+    """dp=1 vs dp=4 with identical global batch: same trajectory."""
+    _, dp1 = _train(pipe=2, dp=1, steps=5)
+    _, dp4 = _train(pipe=2, dp=4, steps=5)
+    # data loader batches differ per dp? no: global micro batch = MICRO*dp —
+    # different batch contents, so only check finite + decreasing
+    assert all(np.isfinite(dp1)) and all(np.isfinite(dp4))
+
+
+def test_pipe_tied_weights_stay_in_sync():
+    engine, losses = _train(pipe=4, dp=2, steps=6, tied=True)
+    assert losses[-1] < losses[0] * 1.1
+    groups = engine.module.tied_groups(engine.num_stages)
+    assert "emb" in groups, "fixture should split the tied pair across stages"
+    stages = groups["emb"]
+    ref = None
+    for s in stages:
+        leaves = jax.tree_util.tree_leaves(
+            jax.device_get(engine.stage_states[s].params["tied_emb"]))
+        flat = np.concatenate([np.asarray(l, np.float32).ravel()
+                               for l in leaves])
+        if ref is None:
+            ref = flat
+        else:
+            np.testing.assert_allclose(flat, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_pipe_tied_matches_sequential():
+    """Tied-weight pipeline must match the 1-stage run exactly."""
+    _, base = _train(pipe=1, dp=2, steps=5, tied=True)
+    _, piped = _train(pipe=4, dp=2, steps=5, tied=True)
+    np.testing.assert_allclose(base, piped, rtol=2e-4)
+
+
+def test_pipe_tied_with_clipping_matches_sequential():
+    """Gradient clipping makes grad-norm errors trajectory-visible: an
+    inflated tied-grad sum (e.g. reduced once per stage instead of once per
+    step) would shrink clip_factor and diverge from the 1-stage run."""
+    extra = {"gradient_clipping": 0.05}
+    _, base = _train(pipe=1, dp=2, steps=5, tied=True, extra=extra)
+    _, piped = _train(pipe=4, dp=2, steps=5, tied=True, extra=extra)
+    # rtol looser than the unclipped tests: clip_factor = clip/gnorm
+    # amplifies last-ulp reassociation differences in the per-stage norm
+    # sum; a tied-reduction bug would show as ~2x gnorm, far beyond this
+    np.testing.assert_allclose(base, piped, rtol=5e-3)
+    np.testing.assert_allclose(base[:2], piped[:2], rtol=1e-5)
+
+
+def test_pipe_parameters_partition_trains():
+    _, losses = _train(pipe=2, dp=2, steps=5,
+                       partition_method="parameters")
+    assert losses[-1] < losses[0]
+
+
+def test_pipe_checkpoint_roundtrip(tmp_path):
+    engine, losses = _train(pipe=2, dp=2, steps=4)
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    before = [np.asarray(jax.device_get(l)) for st in engine.stage_states
+              for l in jax.tree_util.tree_leaves(st.params)]
+
+    engine2, _ = _train(pipe=2, dp=2, steps=2, seed=3)
+    path, _ = engine2.load_checkpoint(str(tmp_path), tag="t1")
+    assert path is not None
+    after = [np.asarray(jax.device_get(l)) for st in engine2.stage_states
+             for l in jax.tree_util.tree_leaves(st.params)]
+    for a, b in zip(before, after):
+        np.testing.assert_array_equal(a, b)
+    assert engine2.global_steps == engine.global_steps
+
+
+def test_pipe_eval_batch():
+    engine, _ = _train(pipe=2, dp=2, steps=3)
+    data = random_dataloader(HIDDEN, 64, MICRO * 2, seed=5)
+    loss = engine.eval_batch(data_iter=data)
+    assert np.isfinite(loss)
+
+
+def test_pipe_forward_raises():
+    engine, _ = _train(pipe=2, dp=2, steps=1)
+    with pytest.raises(RuntimeError):
+        engine.forward({"x": np.ones((4, HIDDEN), np.float32)})
+    with pytest.raises(RuntimeError):
+        engine.backward(None)
+    with pytest.raises(RuntimeError):
+        engine.step()
+
+
+def test_pipe_bf16():
+    _, losses = _train(pipe=2, dp=2, steps=6,
+                       extra={"bf16": {"enabled": True}})
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 1.05
+
+
+def test_pipe_zero1():
+    _, base = _train(pipe=2, dp=2, steps=5)
+    _, z1 = _train(pipe=2, dp=2, steps=5,
+                   extra={"zero_optimization": {"stage": 1}})
+    np.testing.assert_allclose(base, z1, rtol=2e-4)

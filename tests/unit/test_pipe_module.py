@@ -1,0 +1,130 @@
+"""PipelineModule partitioning/tied-weight tests — reference
+tests/unit/test_pipe_module.py pattern."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                               TiedLayerSpec)
+from tests.unit.simple_model import make_stack_specs
+
+
+def _build(n_layers=8, tied=False, **kw):
+    specs, loss_fn, input_fn = make_stack_specs(8, n_layers, tied_head=tied)
+    module = PipelineModule(specs, loss_fn=loss_fn, input_fn=input_fn, **kw)
+    batch = {"x": np.ones((4, 8), np.float32),
+             "y": np.zeros((4,), np.int32)}
+    params = module.init(jax.random.PRNGKey(0), batch)
+    return module, params, batch
+
+
+def test_init_params_keys():
+    module, params, _ = _build(n_layers=3)
+    # 3 stack layers + head, no tied
+    assert sorted(params.keys()) == [f"layer_{i:02d}" for i in range(4)]
+
+
+def test_tied_params_shared():
+    module, params, _ = _build(n_layers=3, tied=True)
+    assert "tied_emb" in params
+    # 3 middle + head own params; the two tied layers share one entry
+    assert len(params) == 5
+    counts = module._param_counts
+    # second tied occurrence contributes 0 (owner carries the weight)
+    assert counts[0] > 0 and counts[4] == 0
+
+
+def test_partition_uniform():
+    module, _, _ = _build(n_layers=6, partition_method="uniform")
+    parts = module.partition_layers(num_stages=2)
+    assert parts[0] == 0 and parts[-1] == 7  # 6 stack + head
+    assert len(parts) == 3
+
+
+def test_partition_parameters_balanced():
+    module, _, _ = _build(n_layers=7, partition_method="parameters")
+    parts = module.partition_layers(num_stages=4)
+    assert parts[0] == 0 and parts[-1] == 8
+    assert all(parts[i] < parts[i + 1] for i in range(4))
+
+
+def test_partition_type_regex():
+    module, _, _ = _build(n_layers=6, partition_method="type:DenseTanh")
+    parts = module.partition_layers(num_stages=3)
+    # only DenseTanh layers carry weight; boundaries still cover all layers
+    assert parts[0] == 0 and parts[-1] == 7
+
+
+def test_partition_unknown_method():
+    module, _, _ = _build(partition_method="nonsense")
+    with pytest.raises(KeyError):
+        module.partition_layers(num_stages=2)
+
+
+def test_stage_param_keys_disjoint_cover():
+    module, params, _ = _build(n_layers=6)
+    module.num_stages = 3
+    all_keys = []
+    for s in range(3):
+        all_keys += module.stage_param_keys(s)
+    assert sorted(all_keys) == sorted(params.keys())
+
+
+def test_tied_groups():
+    module, params, _ = _build(n_layers=6, tied=True,
+                               partition_method="uniform")
+    groups = module.tied_groups(num_stages=4)
+    # first and last tied layer land on different stages
+    assert "emb" in groups and len(groups["emb"]) == 2
+
+
+def test_forward_full_matches_stagewise():
+    module, params, batch = _build(n_layers=6, partition_method="uniform")
+    module.num_stages = 3
+    rng = jax.random.PRNGKey(1)
+    full = module.forward_full(params, batch, rng, train=False)
+    x = module.input_fn(batch)
+    for s in range(3):
+        x = module.forward_stage(params, x, s, rng, train=False)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(x), rtol=1e-6)
+
+
+def test_loss_runs():
+    module, params, batch = _build(n_layers=2)
+    loss, metrics = module.loss(params, batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))
+
+
+def test_activation_checkpoint_interval_same_output():
+    module, params, batch = _build(n_layers=6)
+    module.activation_checkpoint_interval = 2
+    rng = jax.random.PRNGKey(1)
+    ckpt = module.forward_full(params, batch, rng, train=True)
+    module.activation_checkpoint_interval = 0
+    plain = module.forward_full(params, batch, rng, train=True)
+    np.testing.assert_allclose(np.asarray(ckpt), np.asarray(plain), rtol=1e-6)
+
+
+def test_remat_grads_match():
+    """Grad equality with/without activation checkpointing (the reference
+    test_activation_checkpointing round-trip property)."""
+    module, params, batch = _build(n_layers=4)
+    rng = jax.random.PRNGKey(1)
+
+    def loss_of(params, interval):
+        module.activation_checkpoint_interval = interval
+        out = module.forward_full(params, batch, rng, train=True)
+        return module.loss_fn(out, batch)[0]
+
+    g_plain = jax.grad(lambda p: loss_of(p, 0))(params)
+    g_ckpt = jax.grad(lambda p: loss_of(p, 2))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_plain),
+                    jax.tree_util.tree_leaves(g_ckpt)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-7)
+
+
+def test_layerspec_repr():
+    spec = LayerSpec(dict)
+    assert "dict" in repr(spec)

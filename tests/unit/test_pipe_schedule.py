@@ -1,0 +1,166 @@
+"""Schedule instruction-stream tests — reference tests/unit/test_pipe_schedule.py
+pattern plus a cross-stage dataflow simulator."""
+import pytest
+
+from deepspeed_tpu.runtime.pipe.schedule import (
+    BackwardPass, DataParallelSchedule, ForwardPass, InferenceSchedule,
+    LoadMicroBatch, OptimizerStep, RecvActivation, RecvGrad, ReduceGrads,
+    ReduceTiedGrads, SendActivation, SendGrad, TrainSchedule)
+
+
+def _flat(sched):
+    return [cmd for step in sched.steps() for cmd in step]
+
+
+def test_instruction_repr_eq():
+    assert repr(ForwardPass(1)) == "ForwardPass(buffer_id=1)"
+    assert ForwardPass(1) == ForwardPass(1)
+    assert ForwardPass(1) != ForwardPass(2)
+    assert ForwardPass(1) != BackwardPass(1)
+
+
+@pytest.mark.parametrize("micro_batches,stages", [(4, 2), (8, 4), (2, 2),
+                                                  (4, 1), (1, 3)])
+def test_train_schedule_each_micro_once(micro_batches, stages):
+    """Every stage forwards and backwards each micro-batch exactly once."""
+    for stage in range(stages):
+        sched = TrainSchedule(micro_batches, stages, stage)
+        cmds = _flat(sched)
+        assert sum(isinstance(c, ForwardPass) for c in cmds) == micro_batches
+        assert sum(isinstance(c, BackwardPass) for c in cmds) == micro_batches
+        # exactly one optimizer step at the very end
+        assert sum(isinstance(c, OptimizerStep) for c in cmds) == 1
+        assert isinstance(cmds[-1], OptimizerStep)
+        assert sum(isinstance(c, ReduceGrads) for c in cmds) == 1
+        assert sum(isinstance(c, ReduceTiedGrads) for c in cmds) == 1
+
+
+@pytest.mark.parametrize("micro_batches,stages", [(4, 2), (8, 4), (2, 2)])
+def test_train_schedule_loads(micro_batches, stages):
+    """First and last stages load every micro-batch; middles load none."""
+    for stage in range(stages):
+        cmds = _flat(TrainSchedule(micro_batches, stages, stage))
+        loads = sum(isinstance(c, LoadMicroBatch) for c in cmds)
+        if stage in (0, stages - 1):
+            assert loads == micro_batches
+        else:
+            assert loads == 0
+
+
+@pytest.mark.parametrize("micro_batches,stages", [(4, 2), (8, 4), (4, 4),
+                                                  (2, 3)])
+def test_train_schedule_dataflow(micro_batches, stages):
+    """Simulate all stages tick-by-tick: every Recv must find a matching Send
+    already enqueued (sends of the same tick processed first) — the deadlock-
+    freedom property the 1F1B interleave guarantees."""
+    streams = [list(TrainSchedule(micro_batches, stages, s).steps())
+               for s in range(stages)]
+    n_ticks = {len(st) for st in streams}
+    assert len(n_ticks) == 1, "all stages emit the same tick count"
+    n_ticks = n_ticks.pop()
+    act_q = [0] * stages   # edge s-1 -> s pending activations
+    grad_q = [0] * stages  # edge s+1 -> s pending grads
+    fwd_done = [0] * stages
+    bwd_done = [0] * stages
+    for t in range(n_ticks):
+        for s in range(stages):
+            for cmd in streams[s][t]:
+                if isinstance(cmd, SendActivation):
+                    act_q[s + 1] += 1
+                elif isinstance(cmd, SendGrad):
+                    grad_q[s - 1] += 1
+        for s in range(stages):
+            for cmd in streams[s][t]:
+                if isinstance(cmd, RecvActivation):
+                    act_q[s] -= 1
+                    assert act_q[s] >= 0, \
+                        f"tick {t} stage {s}: recv before send"
+                elif isinstance(cmd, RecvGrad):
+                    grad_q[s] -= 1
+                    assert grad_q[s] >= 0, \
+                        f"tick {t} stage {s}: recv grad before send"
+                elif isinstance(cmd, ForwardPass):
+                    # a stage can't forward micro i before stage-1 forwarded it
+                    if s > 0:
+                        assert fwd_done[s - 1] > fwd_done[s]
+                    fwd_done[s] += 1
+                elif isinstance(cmd, BackwardPass):
+                    if s < stages - 1:
+                        assert bwd_done[s + 1] > bwd_done[s]
+                    bwd_done[s] += 1
+    assert fwd_done == [micro_batches] * stages
+    assert bwd_done == [micro_batches] * stages
+    # all queues drained
+    assert act_q == [0] * stages and grad_q == [0] * stages
+
+
+def test_train_schedule_tick_count():
+    sched = TrainSchedule(micro_batches=4, stages=2, stage_id=0)
+    assert len(list(sched.steps())) == 2 * (4 + 2 - 1)
+
+
+@pytest.mark.parametrize("stages,stage,micro,expected", [
+    (4, 0, 8, 5), (4, 3, 8, 2), (2, 0, 4, 3), (2, 1, 4, 2),
+    (4, 0, 2, 2),  # bounded below by 2, above by micro_batches
+])
+def test_num_pipe_buffers(stages, stage, micro, expected):
+    """buffer count = max(2, min(stages - stage + 1, micro_batches))
+    (reference schedule.py:243)."""
+    assert TrainSchedule(micro, stages, stage).num_pipe_buffers() == expected
+
+
+def test_buffer_ids_within_bounds():
+    for stages in (2, 4):
+        for stage in range(stages):
+            sched = TrainSchedule(8, stages, stage)
+            n = sched.num_pipe_buffers()
+            for cmd in _flat(sched):
+                if hasattr(cmd, "buffer_id"):
+                    assert 0 <= cmd.buffer_id < n
+
+
+def test_backward_follows_forward_same_buffer():
+    """Within a stage, micro i's backward comes after its forward, and both
+    use the same buffer id."""
+    for stage in range(2):
+        sched = TrainSchedule(4, 2, stage)
+        fwd_buf = {}
+        n_fwd = n_bwd = 0
+        for cmd in _flat(sched):
+            if isinstance(cmd, ForwardPass):
+                fwd_buf[n_fwd] = cmd.buffer_id
+                n_fwd += 1
+            elif isinstance(cmd, BackwardPass):
+                assert n_bwd in fwd_buf, "backward before forward"
+                assert cmd.buffer_id == fwd_buf[n_bwd]
+                n_bwd += 1
+
+
+def test_inference_schedule():
+    micro, stages = 4, 2
+    for stage in range(stages):
+        sched = InferenceSchedule(micro, stages, stage)
+        cmds = _flat(sched)
+        assert sum(isinstance(c, ForwardPass) for c in cmds) == micro
+        assert not any(isinstance(c, BackwardPass) for c in cmds)
+        assert sched.num_pipe_buffers() == 2
+        loads = sum(isinstance(c, LoadMicroBatch) for c in cmds)
+        assert loads == micro  # stage 0 and last both load
+
+
+def test_data_parallel_schedule():
+    sched = DataParallelSchedule(micro_batches=3, stages=1, stage_id=0)
+    steps = list(sched.steps())
+    assert len(steps) == 3
+    assert sched.num_pipe_buffers() == 1
+    assert isinstance(steps[-1][-1], OptimizerStep)
+    assert not any(isinstance(c, OptimizerStep) for c in steps[0])
+
+
+def test_schedule_properties():
+    sched = TrainSchedule(4, 3, 1)
+    assert sched.stage == 1
+    assert sched.num_stages == 3
+    assert sched.num_micro_batches == 4
+    assert not sched.is_first_stage
+    assert not sched.is_last_stage

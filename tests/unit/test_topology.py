@@ -1,0 +1,113 @@
+"""Topology/grid math tests — mirrors reference tests/unit/test_topology.py."""
+import pytest
+
+from deepspeed_tpu.runtime.pipe.topology import (
+    PipeDataParallelTopology, PipelineParallelGrid,
+    PipeModelDataParallelTopology, ProcessTopology)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["x", "y"], dims=[2, 3])
+    assert topo.world_size() == 6
+    assert topo.get_rank(x=0, y=0) == 0
+    assert topo.get_rank(x=0, y=1) == 1
+    assert topo.get_rank(x=1, y=0) == 3
+    assert topo.get_dim("y") == 3
+    assert topo.get_dim("missing") == 0
+    coord = topo.get_coord(4)
+    assert coord.x == 1 and coord.y == 1
+
+
+def test_topology_comm_lists():
+    topo = ProcessTopology(axes=["pipe", "data", "model"], dims=[2, 2, 2])
+    # varying only pipe: pairs differing by 4
+    assert topo.get_axis_comm_lists("pipe") == [[0, 4], [1, 5], [2, 6], [3, 7]]
+    assert topo.get_axis_comm_lists("data") == [[0, 2], [1, 3], [4, 6], [5, 7]]
+    assert topo.get_axis_comm_lists("model") == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    assert topo.get_axis_comm_lists("missing") == []
+
+
+def test_topology_filter_match():
+    topo = ProcessTopology(axes=["pipe", "data", "model"], dims=[2, 2, 2])
+    assert topo.filter_match(pipe=0, data=1) == [2, 3]
+    assert topo.filter_match(model=0) == [0, 2, 4, 6]
+    assert topo.get_axis_list("data", 0) == [0, 1, 4, 5]
+
+
+def test_topology_rank_repr():
+    topo = ProcessTopology(axes=["a", "b"], dims=[2, 2])
+    assert topo.get_rank_repr(rank=3, omit_axes=[]) == "a_01-b_01"
+    assert topo.get_rank_repr(rank=3, omit_axes=["a"]) == "b_01"
+    # default omits data/pipe axes entirely
+    topo2 = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    assert topo2.get_rank_repr(rank=0) == ""
+
+
+def test_topology_rank_errors():
+    topo = ProcessTopology(axes=["x", "y"], dims=[2, 2])
+    with pytest.raises(ValueError):
+        topo.get_rank(x=0)  # partial coordinate
+    with pytest.raises(ValueError):
+        topo.get_coord(99)
+
+
+def test_pipe_data_topology_axis_order():
+    """Data innermost: adjacent ranks share a pipe stage (gradient reduction
+    on the fast links)."""
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    assert topo.get_rank(pipe=0, data=3) == 3
+    assert topo.get_rank(pipe=1, data=0) == 4
+
+
+def test_pipe_model_data_topology_model_innermost():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.get_axis_names() == ["pipe", "data", "model"]
+    assert topo.get_rank(pipe=0, data=0, model=1) == 1
+    assert topo.get_rank(pipe=0, data=1, model=0) == 2
+    assert topo.get_rank(pipe=1, data=0, model=0) == 4
+
+
+def test_grid_basic():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo, rank=5)
+    # rank 5 = coords (pipe=1, data=0, model=1)
+    assert grid.get_pipe_parallel_rank() == 1
+    assert grid.get_data_parallel_rank() == 0
+    assert grid.get_model_parallel_rank() == 1
+    assert grid.get_pipe_parallel_world_size() == 2
+    assert grid.get_data_parallel_world_size() == 2
+    assert grid.get_slice_parallel_world_size() == 2
+    assert grid.get_pipe_parallel_group() == [1, 5]
+    assert grid.get_data_parallel_group() == [5, 7]
+    assert grid.get_slice_parallel_group() == [4, 5]
+    assert grid.is_last_stage() and not grid.is_first_stage()
+    assert grid.as_mesh_shape() == {"pipe": 2, "data": 2, "model": 2}
+
+
+def test_grid_p2p_pairs():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=1)
+    grid = PipelineParallelGrid(topology=topo, rank=0)
+    # adjacent + wraparound pairs
+    assert [0, 1] in grid.p2p_groups
+    assert [2, 3] in grid.p2p_groups
+    assert [0, 3] in grid.p2p_groups  # wraparound
+
+
+def test_grid_ppermute_perm():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=1)
+    grid = PipelineParallelGrid(topology=topo, rank=0)
+    assert grid.ppermute_perm() == [(0, 1), (1, 2), (2, 3), (3, 0)]
+    assert grid.ppermute_perm(reverse=True) == [(0, 3), (1, 0), (2, 1), (3, 2)]
+
+
+def test_grid_default_world_size():
+    grid = PipelineParallelGrid(world_size=4, rank=2)
+    assert grid.get_data_parallel_world_size() == 4
+    assert grid.get_pipe_parallel_world_size() == 1
+    assert grid.get_data_parallel_rank() == 2
+
+
+def test_stage_to_global():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo, rank=1)  # pipe0,data0,model1
+    assert grid.stage_to_global(1) == 5  # same data/model coords, stage 1

@@ -1,0 +1,209 @@
+"""BERT — encoder model family built on DeepSpeedTransformerLayer.
+
+Reference: the fused-kernel BERT pretraining flow (docs 'fastest BERT
+training') and the test-fixture BERT implementations used as kernel ground
+truth (tests/unit/modeling.py:1-1578 post-LN, modelingpreln.py pre-LN). This
+is the TPU bench model for the BERT-large pretrain baseline (SURVEY §6).
+"""
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.transformer.transformer import (
+    DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    pre_layer_norm: bool = True
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+
+BERT_SIZES = {
+    "bert-base": dict(hidden_size=768, num_hidden_layers=12,
+                      num_attention_heads=12, intermediate_size=3072),
+    "bert-large": dict(hidden_size=1024, num_hidden_layers=24,
+                       num_attention_heads=16, intermediate_size=4096),
+}
+
+
+def bert_config(name: str, **overrides) -> BertConfig:
+    base = dict(BERT_SIZES[name])
+    base.update(overrides)
+    return BertConfig(**base)
+
+
+def _layer_config(cfg: BertConfig) -> DeepSpeedTransformerConfig:
+    return DeepSpeedTransformerConfig(
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        heads=cfg.num_attention_heads,
+        attn_dropout_ratio=cfg.attention_probs_dropout_prob,
+        hidden_dropout_ratio=cfg.hidden_dropout_prob,
+        num_hidden_layers=cfg.num_hidden_layers,
+        initializer_range=cfg.initializer_range,
+        layer_norm_eps=cfg.layer_norm_eps,
+        bf16=cfg.dtype == jnp.bfloat16,
+        fp16=cfg.dtype == jnp.float16,
+        pre_layer_norm=cfg.pre_layer_norm,
+        normalize_invertible=cfg.remat)
+
+
+class BertEmbeddings(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, train: bool):
+        cfg = self.config
+        S = input_ids.shape[1]
+        word = self.param("word_embeddings", nn.initializers.normal(
+            cfg.initializer_range), (cfg.vocab_size, cfg.hidden_size),
+            jnp.float32)
+        pos = self.param("position_embeddings", nn.initializers.normal(
+            cfg.initializer_range),
+            (cfg.max_position_embeddings, cfg.hidden_size), jnp.float32)
+        typ = self.param("token_type_embeddings", nn.initializers.normal(
+            cfg.initializer_range), (cfg.type_vocab_size, cfg.hidden_size),
+            jnp.float32)
+        x = word.astype(cfg.dtype)[input_ids] \
+            + pos.astype(cfg.dtype)[None, :S] \
+            + typ.astype(cfg.dtype)[token_type_ids]
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ln")(x)
+        if train and cfg.hidden_dropout_prob > 0:
+            x = nn.Dropout(cfg.hidden_dropout_prob)(x, deterministic=False)
+        return x
+
+
+class BertEncoder(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask, train: bool):
+        layer_cfg = _layer_config(self.config)
+        for i in range(self.config.num_hidden_layers):
+            x = DeepSpeedTransformerLayer(layer_cfg, name=f"layer_{i}")(
+                x, attention_mask, train=train)
+        if self.config.pre_layer_norm:
+            x = nn.LayerNorm(epsilon=self.config.layer_norm_eps,
+                             dtype=self.config.dtype, name="final_ln")(x)
+        return x
+
+
+class BertForPreTrainingModule(nn.Module):
+    """Embeddings -> encoder -> MLM head (tied decoder) + NSP head."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 train: bool = False):
+        cfg = self.config
+        B, S = input_ids.shape
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        # HF-style extended additive mask: (B, 1, 1, S), 0 keep / -1e30 drop
+        ext_mask = None
+        if attention_mask is not None:
+            ext_mask = (1.0 - attention_mask[:, None, None, :]
+                        .astype(jnp.float32)) * -1e30
+
+        emb = BertEmbeddings(cfg, name="embeddings")
+        x = emb(input_ids, token_type_ids, train)
+        x = BertEncoder(cfg, name="encoder")(x, ext_mask, train)
+
+        # MLM: transform -> LN -> tied decoder over word embeddings
+        word = self.variables["params"]["embeddings"]["word_embeddings"]
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     name="mlm_transform")(x)
+        h = nn.gelu(h, approximate=False)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="mlm_ln")(h)
+        mlm_bias = self.param("mlm_bias", nn.initializers.zeros,
+                              (cfg.vocab_size,), jnp.float32)
+        logits = jnp.einsum("bse,ve->bsv", h, word.astype(cfg.dtype)) \
+            + mlm_bias.astype(cfg.dtype)
+
+        # NSP over the pooled [CLS]
+        pooled = nn.tanh(nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                                  name="pooler")(x[:, 0]))
+        nsp_logits = nn.Dense(2, dtype=cfg.dtype, name="nsp")(pooled)
+        return logits, nsp_logits
+
+
+class BertForPreTraining:
+    """Engine model contract: masked-LM (+ optional NSP) pretraining loss.
+
+    batch keys: input_ids, attention_mask (optional), token_type_ids
+    (optional), masked_lm_labels (-1 or -100 = unmasked), next_sentence_label
+    (optional).
+    """
+
+    def __init__(self, config: BertConfig):
+        self.config = config
+        self.module = BertForPreTrainingModule(config)
+
+    def init(self, rng, batch):
+        return self.module.init(
+            {"params": rng, "dropout": rng}, batch["input_ids"],
+            batch.get("attention_mask"), batch.get("token_type_ids"),
+            train=False)["params"]
+
+    def loss(self, params, batch, rng, train=True):
+        logits, nsp_logits = self.module.apply(
+            {"params": params}, batch["input_ids"],
+            batch.get("attention_mask"), batch.get("token_type_ids"),
+            train=train, rngs={"dropout": rng})
+        labels = batch["masked_lm_labels"]
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        safe = jnp.maximum(labels, 0)
+        gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        mask = (labels >= 0).astype(jnp.float32)
+        mlm_loss = jnp.sum((logz - gold) * mask) / jnp.maximum(
+            jnp.sum(mask), 1.0)
+        total = mlm_loss
+        metrics = {"mlm_loss": mlm_loss}
+        if "next_sentence_label" in batch:
+            nsp_logp = jax.nn.log_softmax(nsp_logits.astype(jnp.float32))
+            nsp_loss = -jnp.mean(jnp.take_along_axis(
+                nsp_logp, batch["next_sentence_label"][:, None], axis=1))
+            total = total + nsp_loss
+            metrics["nsp_loss"] = nsp_loss
+        metrics["loss"] = total
+        return total, metrics
+
+    def param_partition_spec(self, params):
+        """TP over 'model': QKV/intermediate out-dim, attn-out/ffn-out
+        in-dim, embeddings vocab dim."""
+        def spec(path, leaf):
+            joined = "/".join(str(getattr(p, "key", p)) for p in path)
+            if leaf.ndim == 0:
+                return P()
+            if "word_embeddings" in joined:
+                return P("model", None)
+            if ("qkv" in joined or "ffn_inter" in joined) and leaf.ndim == 2:
+                return P(None, "model")
+            if ("attn_out" in joined or "ffn_out" in joined) and leaf.ndim == 2:
+                return P("model", None)
+            return P()
+
+        return jax.tree_util.tree_map_with_path(spec, params)
+
+    def num_params(self, params):
+        return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,2 @@
+from deepspeed_tpu.module_inject.replace_module import (
+    inject_bert_layer_params, replace_bert_params, revert_bert_layer_params)

@@ -1,0 +1,108 @@
+"""module_inject — swap a model's encoder layers for the fused layer.
+
+Reference behavior: deepspeed/module_inject/{inject.py:6-107,
+replace_module.py:6-181}: walk a HF BERT model, replace each BertLayer with
+DeepSpeedTransformerLayer, concatenating q/k/v weights into the fused qkv
+parameter; `revert_module` splits them back.
+
+TPU formulation: models are (module, params) pairs, so injection is pure
+param surgery — `inject_bert_layer_params` maps one HF-Flax-style BertLayer
+param subtree onto a DeepSpeedTransformerLayer subtree (fusing q/k/v),
+`revert_bert_layer_params` inverts it, and `replace_bert_params` applies the
+mapping across a whole encoder. The swapped-in module is the same
+DeepSpeedTransformerLayer the reference injects; on TPU the fusion win comes
+from XLA compiling the layer as one program (flash attention + fused
+LN/GeLU/bias), so "injection" only needs to rearrange parameters.
+"""
+import re
+
+import numpy as np
+
+
+def _cat(*arrays, axis):
+    return np.concatenate([np.asarray(a) for a in arrays], axis=axis)
+
+
+def inject_bert_layer_params(hf_layer, preln=False):
+    """HF-Flax BertLayer params -> DeepSpeedTransformerLayer params.
+
+    hf_layer keys (HF flax naming):
+      attention/self/{query,key,value}/{kernel,bias},
+      attention/output/dense/{kernel,bias},
+      attention/output/LayerNorm/{scale,bias},
+      intermediate/dense/{kernel,bias},
+      output/dense/{kernel,bias}, output/LayerNorm/{scale,bias}
+    Kernels are (in, out) as flax stores them (the reference concatenates
+    torch (out, in) weights on dim 0, inject.py:41-43 — here the fused qkv
+    concatenates on the OUT dim, axis 1).
+    """
+    att = hf_layer["attention"]
+    qkv_kernel = _cat(att["self"]["query"]["kernel"],
+                      att["self"]["key"]["kernel"],
+                      att["self"]["value"]["kernel"], axis=1)
+    qkv_bias = _cat(att["self"]["query"]["bias"],
+                    att["self"]["key"]["bias"],
+                    att["self"]["value"]["bias"], axis=0)
+    return {"body": {
+        "qkv": {"kernel": qkv_kernel, "bias": qkv_bias},
+        "attn_out": {"kernel": np.asarray(att["output"]["dense"]["kernel"]),
+                     "bias": np.asarray(att["output"]["dense"]["bias"])},
+        "attn_ln": {"scale": np.asarray(att["output"]["LayerNorm"]["scale"]),
+                    "bias": np.asarray(att["output"]["LayerNorm"]["bias"])},
+        "ffn_inter": {"kernel": np.asarray(
+            hf_layer["intermediate"]["dense"]["kernel"]),
+            "bias": np.asarray(hf_layer["intermediate"]["dense"]["bias"])},
+        "ffn_out": {"kernel": np.asarray(hf_layer["output"]["dense"]["kernel"]),
+                    "bias": np.asarray(hf_layer["output"]["dense"]["bias"])},
+        "ffn_ln": {"scale": np.asarray(hf_layer["output"]["LayerNorm"]["scale"]),
+                   "bias": np.asarray(hf_layer["output"]["LayerNorm"]["bias"])},
+    }}
+
+
+def revert_bert_layer_params(ds_layer, hidden_size):
+    """DeepSpeedTransformerLayer params -> HF-Flax BertLayer params
+    (reference replace_module.py revert path, :93-161)."""
+    body = ds_layer["body"]
+    qkv_k = np.asarray(body["qkv"]["kernel"])
+    qkv_b = np.asarray(body["qkv"]["bias"])
+    q_k, k_k, v_k = np.split(qkv_k, 3, axis=1)
+    q_b, k_b, v_b = np.split(qkv_b, 3, axis=0)
+    return {
+        "attention": {
+            "self": {"query": {"kernel": q_k, "bias": q_b},
+                     "key": {"kernel": k_k, "bias": k_b},
+                     "value": {"kernel": v_k, "bias": v_b}},
+            "output": {
+                "dense": {"kernel": np.asarray(body["attn_out"]["kernel"]),
+                          "bias": np.asarray(body["attn_out"]["bias"])},
+                "LayerNorm": {"scale": np.asarray(body["attn_ln"]["scale"]),
+                              "bias": np.asarray(body["attn_ln"]["bias"])}}},
+        "intermediate": {"dense": {
+            "kernel": np.asarray(body["ffn_inter"]["kernel"]),
+            "bias": np.asarray(body["ffn_inter"]["bias"])}},
+        "output": {
+            "dense": {"kernel": np.asarray(body["ffn_out"]["kernel"]),
+                      "bias": np.asarray(body["ffn_out"]["bias"])},
+            "LayerNorm": {"scale": np.asarray(body["ffn_ln"]["scale"]),
+                          "bias": np.asarray(body["ffn_ln"]["bias"])}},
+    }
+
+
+def replace_bert_params(hf_params, layer_pattern=r"^layer_?(\d+)$",
+                        preln=False):
+    """Map every matching layer subtree of an HF-Flax encoder param dict
+    (e.g. params['encoder']['layer']) through inject_bert_layer_params.
+
+    Returns {our_layer_name: ds_params} with names 'layer_<i>' matching
+    models/bert.py BertEncoder."""
+    out = {}
+    for name, sub in hf_params.items():
+        m = re.match(layer_pattern, str(name))
+        if m:
+            out[f"layer_{int(m.group(1))}"] = inject_bert_layer_params(
+                sub, preln=preln)
+    if not out:
+        raise ValueError(
+            f"no layers matched pattern {layer_pattern!r} among "
+            f"{sorted(map(str, hf_params.keys()))[:8]}")
+    return out

@@ -1,0 +1,92 @@
+"""Helpers for using block-sparse attention with real models.
+
+Reference behavior: deepspeed/ops/sparse_attention/sparse_attention_utils.py:
+13-225 (pad/unpad sequences to a block multiple, extend position
+embeddings). The HF-model surgery part of the reference
+(replace_self_attention_layer_with_sparse_self_attention_layer) lives with
+module_inject in this build.
+"""
+from typing import Optional
+
+import numpy as np
+
+
+class SparseAttentionUtils:
+    @staticmethod
+    def extend_position_embedding(pos_embedding, max_position: int):
+        """Tile an existing (P, E) position-embedding table to cover
+        max_position rows (reference :25-59 extends HF models in place; here
+        the array is returned for functional param surgery)."""
+        import jax.numpy as jnp
+
+        pos_embedding = jnp.asarray(pos_embedding)
+        P, E = pos_embedding.shape
+        assert max_position > P, \
+            f"max_position {max_position} must exceed current {P}"
+        reps = -(-max_position // P)
+        return jnp.tile(pos_embedding, (reps, 1))[:max_position]
+
+    @staticmethod
+    def pad_to_block_size(block_size: int, input_ids=None, attention_mask=None,
+                          token_type_ids=None, position_ids=None,
+                          inputs_embeds=None, pad_token_id: int = 0,
+                          model_embeddings=None):
+        """Pad sequence dim (axis 1) up to a block multiple.
+
+        Returns (pad_len, input_ids, attention_mask, token_type_ids,
+        position_ids, inputs_embeds) — the reference's tuple layout
+        (reference :61-147). Padded attention-mask entries are 0 so padding
+        never attends/attended.
+        """
+        import jax.numpy as jnp
+
+        ref = input_ids if input_ids is not None else inputs_embeds
+        assert ref is not None, "need input_ids or inputs_embeds"
+        seq_len = ref.shape[1]
+        pad_len = (-seq_len) % block_size
+        if pad_len == 0:
+            return (0, input_ids, attention_mask, token_type_ids,
+                    position_ids, inputs_embeds)
+
+        def pad(x, value=0):
+            if x is None:
+                return None
+            widths = [(0, 0)] * x.ndim
+            widths[1] = (0, pad_len)
+            return jnp.pad(jnp.asarray(x), widths, constant_values=value)
+
+        input_ids = pad(input_ids, pad_token_id)
+        attention_mask = pad(attention_mask, 0)
+        token_type_ids = pad(token_type_ids, 0)
+        if position_ids is not None:
+            # continue positions monotonically so extended tables index fine
+            import jax.numpy as jnp2
+
+            extra = jnp2.arange(seq_len, seq_len + pad_len)
+            extra = jnp2.broadcast_to(extra, position_ids.shape[:-1] +
+                                      (pad_len,))
+            position_ids = jnp2.concatenate(
+                [jnp2.asarray(position_ids), extra], axis=1)
+        if inputs_embeds is not None:
+            assert model_embeddings is not None or pad_token_id == 0, \
+                "padding embeddings needs the embedding table"
+            if model_embeddings is not None:
+                pad_embed = jnp.asarray(model_embeddings)[pad_token_id]
+                pad_block = jnp.broadcast_to(
+                    pad_embed, (inputs_embeds.shape[0], pad_len,
+                                inputs_embeds.shape[2]))
+            else:
+                pad_block = jnp.zeros((inputs_embeds.shape[0], pad_len,
+                                       inputs_embeds.shape[2]),
+                                      inputs_embeds.dtype)
+            inputs_embeds = jnp.concatenate(
+                [jnp.asarray(inputs_embeds), pad_block], axis=1)
+        return (pad_len, input_ids, attention_mask, token_type_ids,
+                position_ids, inputs_embeds)
+
+    @staticmethod
+    def unpad_sequence_output(pad_len: int, sequence_output):
+        """Strip the padding added by pad_to_block_size (reference :149-163)."""
+        if pad_len == 0:
+            return sequence_output
+        return sequence_output[:, :-pad_len]

@@ -170,7 +170,6 @@ class VariableSparsityConfig(SparsityConfig):
     def set_local_layout(self, h, layout):
         n = layout.shape[1]
         start = 0
-        size = self.local_window_blocks[-1]
         for size in self.local_window_blocks:
             end = min(start + size, n)
             layout[h, start:end, start:end] = 1

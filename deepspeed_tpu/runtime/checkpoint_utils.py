@@ -1,0 +1,31 @@
+"""Checkpoint serialization helpers shared by the engines.
+
+np.savez stores ml_dtypes arrays (bfloat16, float8_*) as raw void ('|V2')
+and np.load cannot interpret them — each leaf's dtype name is recorded
+alongside and void payloads are re-viewed through ml_dtypes on load
+(bit-exact round trip)."""
+import numpy as np
+
+
+def leaves_to_npz_dict(flat_leaves):
+    """Host/device leaves -> kwargs for np.savez (leaf_i + dtype_i pairs)."""
+    out = {}
+    for i, leaf in enumerate(flat_leaves):
+        arr = np.asarray(leaf)
+        out[f"leaf_{i}"] = arr
+        out[f"dtype_{i}"] = np.str_(str(arr.dtype))
+    return out
+
+
+def npz_dict_to_leaves(data):
+    """Inverse of leaves_to_npz_dict; returns the list of numpy leaves."""
+    n = sum(1 for name in data.files if name.startswith("leaf_"))
+    leaves = []
+    for i in range(n):
+        arr = data[f"leaf_{i}"]
+        if arr.dtype.kind == "V" and f"dtype_{i}" in data.files:
+            import ml_dtypes
+
+            arr = arr.view(np.dtype(getattr(ml_dtypes, str(data[f"dtype_{i}"]))))
+        leaves.append(arr)
+    return leaves

@@ -854,10 +854,13 @@ class DeepSpeedEngine:
             with jax.set_mesh(self.mesh):
                 state = jax.jit(lambda s: s, out_shardings=rep_tree)(state)
         if jax.process_index() == 0:
+            from deepspeed_tpu.runtime.checkpoint_utils import \
+                leaves_to_npz_dict
+
             host_state = jax.device_get(state)
             flat, treedef = jax.tree_util.tree_flatten(host_state)
             np.savez(os.path.join(path, "model_states.npz"),
-                     **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(flat)})
+                     **leaves_to_npz_dict(flat))
             meta = {
                 "global_steps": self.global_steps,
                 "micro_steps": self.micro_steps,
@@ -890,8 +893,11 @@ class DeepSpeedEngine:
         path = os.path.join(load_dir, str(tag))
         with open(os.path.join(path, "metadata.pkl"), "rb") as f:
             meta = pickle.load(f)
+        from deepspeed_tpu.runtime.checkpoint_utils import npz_dict_to_leaves
+
         data = np.load(os.path.join(path, "model_states.npz"))
-        flat = [data[f"leaf_{i}"] for i in range(meta["num_leaves"])]
+        flat = npz_dict_to_leaves(data)
+        assert len(flat) == meta["num_leaves"]
 
         assert self.state is not None, \
             "call forward/train_batch once (or init_from_batch) before load_checkpoint"

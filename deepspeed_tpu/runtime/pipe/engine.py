@@ -163,7 +163,14 @@ class PipelineEngine(DeepSpeedEngine):
         # init full params on host once (layer by layer), then scatter each
         # stage's slice to its submesh
         init_rng, self._pipe_rng = jax.random.split(self._init_rng)
-        with jax.default_device(jax.local_devices()[0]):
+        # init on the HOST cpu backend: local_devices()[0] would be an
+        # accelerator chip and the full fp32 model + a whole-model forward
+        # would defeat per-stage memory scaling
+        try:
+            host_dev = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:  # pragma: no cover - cpu backend always exists
+            host_dev = jax.local_devices()[0]
+        with jax.default_device(host_dev):
             full_params = self.module.init(init_rng, sample_micro)
         full_params = jax.tree_util.tree_map(
             lambda l: np.asarray(jax.device_get(l), dtype=np.float32),
@@ -231,9 +238,16 @@ class PipelineEngine(DeepSpeedEngine):
                     loss = fwd_loss(params, x, rng, batch)
                     return loss.astype(jnp.float32) * scale / gas, loss
 
-                (_, loss), grads = jax.value_and_grad(
-                    scaled, argnums=(0, 1), has_aux=True)(params, x)
-                gp, gx = grads
+                # integer x (token ids reaching the last stage when pipe=1)
+                # is not differentiable and its grad is never sent anywhere
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+                    (_, loss), grads = jax.value_and_grad(
+                        scaled, argnums=(0, 1), has_aux=True)(params, x)
+                    gp, gx = grads
+                else:
+                    (_, loss), gp = jax.value_and_grad(
+                        scaled, argnums=0, has_aux=True)(params, x)
+                    gx = jnp.zeros((), jnp.float32)
                 return gp, gx, loss
 
             def bwd_mid(params, x, rng, gy, fwd=fwd):
@@ -575,11 +589,13 @@ class PipelineEngine(DeepSpeedEngine):
             tag = f"global_step{self.global_steps}"
         path = os.path.join(save_dir, str(tag))
         os.makedirs(path, exist_ok=True)
+        from deepspeed_tpu.runtime.checkpoint_utils import leaves_to_npz_dict
+
         for s, st in enumerate(self.stage_states):
             host = jax.device_get(st)
             flat, _ = jax.tree_util.tree_flatten(host)
             np.savez(os.path.join(path, f"stage_{s:02d}_states.npz"),
-                     **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(flat)})
+                     **leaves_to_npz_dict(flat))
         meta = {
             "global_steps": self.global_steps,
             "micro_steps": self.micro_steps,
@@ -621,10 +637,12 @@ class PipelineEngine(DeepSpeedEngine):
              f"layer-granular save (planned)")
         assert self.stage_states is not None, \
             "run one batch (or _ensure_pipe_state) before load_checkpoint"
+        from deepspeed_tpu.runtime.checkpoint_utils import npz_dict_to_leaves
+
         new_states = []
         for s, st in enumerate(self.stage_states):
             data = np.load(os.path.join(path, f"stage_{s:02d}_states.npz"))
-            flat = [data[f"leaf_{i}"] for i in range(len(data.files))]
+            flat = npz_dict_to_leaves(data)
             treedef = jax.tree_util.tree_structure(jax.device_get(st))
             host = jax.tree_util.tree_unflatten(treedef, flat)
             dev = jax.tree_util.tree_map(

@@ -134,6 +134,43 @@ def test_pipe_checkpoint_roundtrip(tmp_path):
     assert engine2.global_steps == engine.global_steps
 
 
+def test_pipe_checkpoint_roundtrip_bf16(tmp_path):
+    """bf16 leaves must survive npz (savez degrades ml_dtypes to raw void)."""
+    engine, _ = _train(pipe=2, dp=2, steps=2,
+                       extra={"bf16": {"enabled": True}})
+    engine.save_checkpoint(str(tmp_path), tag="b1")
+    engine2, _ = _train(pipe=2, dp=2, steps=1, seed=9,
+                        extra={"bf16": {"enabled": True}})
+    path, _ = engine2.load_checkpoint(str(tmp_path), tag="b1")
+    assert path is not None
+    for st1, st2 in zip(engine.stage_states, engine2.stage_states):
+        for a, b in zip(jax.tree_util.tree_leaves(st1.params),
+                        jax.tree_util.tree_leaves(st2.params)):
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(
+                np.asarray(a, np.float32), np.asarray(b, np.float32))
+
+
+def test_gpt2_pipe_single_stage_int_input():
+    """pipe=1 makes the LAST stage consume integer token ids — the backward
+    must not differentiate w.r.t. them."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline_module
+
+    cfg = GPT2Config(vocab_size=64, n_positions=32, n_embd=16, n_layer=2,
+                     n_head=2, dtype=jnp.float32)
+    module = gpt2_pipeline_module(cfg, partition_method="uniform")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=module, config_params=_config(dp=2, pipe=1))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 64, (GAS, MICRO * 2, 32)),
+             "labels": rng.integers(0, 64, (GAS, MICRO * 2, 32))}
+    loss = engine.train_batch(batch=batch)
+    assert np.isfinite(loss)
+
+
 def test_pipe_eval_batch():
     engine, _ = _train(pipe=2, dp=2, steps=3)
     data = random_dataloader(HIDDEN, 64, MICRO * 2, seed=5)

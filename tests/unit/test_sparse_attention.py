@@ -1,0 +1,236 @@
+"""Block-sparse attention tests — reference tests/unit/test_sparse_attention.py
+pattern: parity against a dense reference with explicit masking."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, SparseAttentionUtils, SparseSelfAttention,
+    SparsityConfig, VariableSparsityConfig, block_sparse_attention,
+    layout_to_token_mask)
+
+B, H, D = 2, 4, 16
+BLOCK = 16
+
+
+def dense_masked_attention(q, k, v, tok_mask, rpe=None, kpm=None, am=None,
+                           kpm_mode="add", am_mode="mul"):
+    """Independent dense reference with explicit token mask."""
+    s = np.einsum("bhqd,bhkd->bhqk", q.astype(np.float64),
+                  k.astype(np.float64)) * (D ** -0.5)
+    if rpe is not None:
+        s = s + rpe
+    if am is not None:
+        if am_mode == "mul":
+            s = np.where(am[None, None] != 0, s, -1e30)
+        else:
+            s = s + am[None, None]
+    if kpm is not None:
+        if kpm_mode == "mul":
+            s = np.where(kpm[:, None, None, :] != 0, s, -1e30)
+        else:
+            s = s + kpm[:, None, None, :]
+    s = np.where(np.asarray(tok_mask)[None], s, -1e30)
+    s = s - s.max(-1, keepdims=True)
+    p = np.exp(s)
+    p = p / p.sum(-1, keepdims=True)
+    p = p * np.asarray(tok_mask)[None].any(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p, v.astype(np.float64))
+
+
+def _qkv(seq, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((B, H, seq, D)).astype(np.float32)
+            for _ in range(3))
+
+
+# ---------------------------------------------------------------------------
+# layout generators
+# ---------------------------------------------------------------------------
+def test_layout_shape_and_divisibility():
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK)
+    layout = cfg.make_layout(128)
+    assert layout.shape == (H, 8, 8)
+    with pytest.raises(ValueError):
+        cfg.make_layout(100)   # not block-divisible
+
+
+def test_dense_layout_all_ones():
+    layout = DenseSparsityConfig(num_heads=H, block=BLOCK).make_layout(64)
+    assert layout.sum() == H * 4 * 4
+
+
+def test_fixed_layout_structure():
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=4,
+                              num_global_blocks=1)
+    layout = np.asarray(cfg.make_layout(BLOCK * 8))
+    # row 0: local window blocks 0-3 plus both windows' global cols {3, 7}
+    np.testing.assert_array_equal(layout[0, 0], [1, 1, 1, 1, 0, 0, 0, 1])
+    assert layout[0, 5, 4:8].all()                        # second window local
+    # global columns: window representatives attended by all rows
+    assert layout[0, :, 3].all() and layout[0, :, 7].all()
+    # heads identical when different_layout_per_head=False
+    assert (layout[1:] == layout[0]).all()
+
+
+def test_fixed_layout_unidirectional_causal():
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=4,
+                              attention="unidirectional")
+    layout = np.asarray(cfg.make_layout(BLOCK * 8))
+    assert np.triu(layout[0], 1).sum() == 0   # nothing above diagonal
+
+
+def test_fixed_layout_different_patterns_per_head():
+    cfg = FixedSparsityConfig(num_heads=4, block=BLOCK, num_local_blocks=4,
+                              different_layout_per_head=True,
+                              num_different_global_patterns=4)
+    layout = np.asarray(cfg.make_layout(BLOCK * 8))
+    # heads rotate which block of each window is the global representative
+    globals_per_head = [set(np.where(layout[h].all(0))[0])
+                        for h in range(4)]
+    assert len({frozenset(g) for g in globals_per_head}) > 1
+
+
+def test_fixed_validation_errors():
+    with pytest.raises(ValueError):
+        FixedSparsityConfig(num_heads=H, num_local_blocks=4,
+                            num_global_blocks=3)
+    with pytest.raises(NotImplementedError):
+        FixedSparsityConfig(num_heads=H, attention="nonsense")
+    with pytest.raises(ValueError):
+        FixedSparsityConfig(num_heads=H, attention="unidirectional",
+                            horizontal_global_attention=True)
+    with pytest.raises(ValueError):
+        FixedSparsityConfig(num_heads=H, num_different_global_patterns=2)
+
+
+def test_variable_layout():
+    cfg = VariableSparsityConfig(num_heads=H, block=BLOCK,
+                                 num_random_blocks=1,
+                                 local_window_blocks=[2, 4],
+                                 global_block_indices=[0])
+    layout = np.asarray(cfg.make_layout(BLOCK * 8))
+    assert layout[0, :, 0].all()              # global column 0
+    assert layout[0, :2, :2].all()            # first window 2 blocks
+    assert layout[0, 2:6, 2:6].all()          # second window 4 blocks
+    assert (layout.sum(-1) >= 1).all()        # random adds >= 1 per row
+
+
+def test_bigbird_layout():
+    cfg = BigBirdSparsityConfig(num_heads=H, block=BLOCK,
+                                num_random_blocks=1,
+                                num_sliding_window_blocks=3,
+                                num_global_blocks=1)
+    layout = np.asarray(cfg.make_layout(BLOCK * 8))
+    n = 8
+    for i in range(n):
+        for j in range(max(0, i - 1), min(n, i + 2)):
+            assert layout[0, i, j] == 1       # sliding window
+    assert layout[0, 0, :].all() and layout[0, :, 0].all()  # global ITC
+
+
+def test_bslongformer_layout():
+    cfg = BSLongformerSparsityConfig(num_heads=H, block=BLOCK,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0, 2])
+    layout = np.asarray(cfg.make_layout(BLOCK * 8))
+    assert layout[0, 0, :].all() and layout[0, :, 0].all()
+    assert layout[0, 2, :].all() and layout[0, :, 2].all()
+
+
+# ---------------------------------------------------------------------------
+# attention computation
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("config_cls,kwargs", [
+    (DenseSparsityConfig, {}),
+    (FixedSparsityConfig, {"num_local_blocks": 2}),
+    (BigBirdSparsityConfig, {"num_random_blocks": 1,
+                             "num_sliding_window_blocks": 3}),
+    (BSLongformerSparsityConfig, {"num_sliding_window_blocks": 3}),
+])
+def test_sparse_attention_matches_dense_reference(config_cls, kwargs):
+    seq = BLOCK * 4
+    q, k, v = _qkv(seq)
+    cfg = config_cls(num_heads=H, block=BLOCK, **kwargs)
+    attn = SparseSelfAttention(sparsity_config=cfg)
+    out = np.asarray(attn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    layout = attn.get_layout(seq)
+    tok_mask = np.asarray(layout_to_token_mask(layout, BLOCK))
+    exp = dense_masked_attention(q, k, v, tok_mask)
+    np.testing.assert_allclose(out, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_attention_with_masks_and_rpe():
+    seq = BLOCK * 4
+    q, k, v = _qkv(seq, seed=1)
+    rng = np.random.default_rng(2)
+    rpe = rng.standard_normal((seq, seq)).astype(np.float32) * 0.1
+    kpm = np.zeros((B, seq), np.float32)
+    kpm[:, -BLOCK:] = -1e30                   # additive pad mask
+    am = np.ones((seq, seq), np.float32)
+    am[:, :2] = 0                             # mul mask: block 2 first tokens
+
+    cfg = DenseSparsityConfig(num_heads=H, block=BLOCK)
+    attn = SparseSelfAttention(sparsity_config=cfg,
+                               key_padding_mask_mode="add",
+                               attn_mask_mode="mul")
+    out = np.asarray(attn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                          rpe=rpe, key_padding_mask=kpm, attn_mask=am))
+    tok_mask = np.ones((H, seq, seq), bool)
+    exp = dense_masked_attention(q, k, v, tok_mask, rpe=rpe, kpm=kpm, am=am)
+    np.testing.assert_allclose(out, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_attention_grads_flow():
+    seq = BLOCK * 2
+    q, k, v = map(jnp.asarray, _qkv(seq, seed=3))
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=2)
+    attn = SparseSelfAttention(sparsity_config=cfg)
+
+    g = jax.grad(lambda q: jnp.sum(jnp.square(attn(q, k, v))))(q)
+    assert np.isfinite(np.asarray(g)).all()
+    assert np.abs(np.asarray(g)).sum() > 0
+
+
+def test_head_count_mismatch_raises():
+    seq = BLOCK * 2
+    q, k, v = map(jnp.asarray, _qkv(seq))
+    attn = SparseSelfAttention(FixedSparsityConfig(num_heads=2, block=BLOCK))
+    with pytest.raises(AssertionError):
+        attn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# utils
+# ---------------------------------------------------------------------------
+def test_pad_to_block_size_and_unpad():
+    ids = np.arange(2 * 100).reshape(2, 100)
+    mask = np.ones((2, 100), np.int32)
+    pad_len, pids, pmask, _, _, _ = SparseAttentionUtils.pad_to_block_size(
+        block_size=16, input_ids=jnp.asarray(ids),
+        attention_mask=jnp.asarray(mask), pad_token_id=7)
+    assert pad_len == 12
+    assert pids.shape == (2, 112) and pmask.shape == (2, 112)
+    assert (np.asarray(pids)[:, 100:] == 7).all()
+    assert (np.asarray(pmask)[:, 100:] == 0).all()
+    out = SparseAttentionUtils.unpad_sequence_output(
+        pad_len, jnp.ones((2, 112, 8)))
+    assert out.shape == (2, 100, 8)
+
+
+def test_pad_noop_when_aligned():
+    ids = np.ones((2, 64), np.int32)
+    pad_len, pids, *_ = SparseAttentionUtils.pad_to_block_size(
+        block_size=16, input_ids=jnp.asarray(ids))
+    assert pad_len == 0 and pids.shape == (2, 64)
+
+
+def test_extend_position_embedding():
+    table = jnp.asarray(np.random.default_rng(0)
+                        .standard_normal((128, 8)).astype(np.float32))
+    ext = SparseAttentionUtils.extend_position_embedding(table, 300)
+    assert ext.shape == (300, 8)
+    np.testing.assert_array_equal(np.asarray(ext[:128]), np.asarray(table))
+    np.testing.assert_array_equal(np.asarray(ext[128:256]), np.asarray(table))

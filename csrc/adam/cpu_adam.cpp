@@ -1,0 +1,168 @@
+// CPU Adam for ZeRO-Offload — host-side optimizer step over pinned fp32
+// state while the TPU holds only compute-dtype params.
+//
+// Reference behavior: csrc/adam/cpu_adam.cpp:21-682 (AVX512/AVX256 SIMD
+// macro layer, OMP parallel tiles, fused fp16 param copy-back). This
+// implementation exposes a plain C ABI (ctypes-friendly — no pybind11 in
+// this image) and adds a bf16 copy-back path, the TPU-native transfer
+// dtype. SIMD width is picked at compile time: AVX-512 (16-wide) /
+// AVX2+FMA (8-wide) / scalar.
+//
+// Semantics match torch.optim.Adam / FusedAdam: bias-corrected first and
+// second moments, optional decoupled (AdamW) or L2 weight decay, fused
+// gradient unscale (grads divided by `grad_scale` on the fly).
+
+#include <cmath>
+#include <cstdint>
+#include <cstddef>
+
+#if defined(__AVX512F__) || defined(__AVX2__)
+#include <immintrin.h>
+#endif
+
+extern "C" {
+
+// Scalar tail / fallback kernel.
+static void adam_scalar(float* p, const float* g, float* m, float* v,
+                        std::size_t begin, std::size_t end, float lr,
+                        float beta1, float beta2, float eps, float wd,
+                        int adamw, float bc1, float bc2, float inv_scale) {
+    for (std::size_t i = begin; i < end; ++i) {
+        float grad = g[i] * inv_scale;
+        if (!adamw && wd > 0.f) grad += wd * p[i];
+        float m_new = beta1 * m[i] + (1.f - beta1) * grad;
+        float v_new = beta2 * v[i] + (1.f - beta2) * grad * grad;
+        float update = (m_new / bc1) / (std::sqrt(v_new / bc2) + eps);
+        if (adamw && wd > 0.f) update += wd * p[i];
+        p[i] -= lr * update;
+        m[i] = m_new;
+        v[i] = v_new;
+    }
+}
+
+// One Adam step over n contiguous fp32 elements, in place.
+//   step: 1-based optimizer step (for bias correction)
+//   grad_scale: grads are divided by this (fused fp16 unscale)
+void ds_adam_step(float* params, const float* grads, float* exp_avg,
+                  float* exp_avg_sq, std::int64_t n, float lr, float beta1,
+                  float beta2, float eps, float weight_decay, int adamw,
+                  int bias_correction, std::int64_t step, float grad_scale) {
+    const float bc1 = bias_correction ? 1.f - std::pow(beta1, (float)step) : 1.f;
+    const float bc2 = bias_correction ? 1.f - std::pow(beta2, (float)step) : 1.f;
+    const float inv_scale = 1.f / grad_scale;
+
+#if defined(__AVX512F__)
+    constexpr std::int64_t W = 16;
+#elif defined(__AVX2__)
+    constexpr std::int64_t W = 8;
+#else
+    constexpr std::int64_t W = 1;
+#endif
+    const std::int64_t vec_end = n - (n % W);
+
+#pragma omp parallel for schedule(static)
+    for (std::int64_t i = 0; i < vec_end; i += W) {
+#if defined(__AVX512F__)
+        __m512 g = _mm512_mul_ps(_mm512_loadu_ps(grads + i),
+                                 _mm512_set1_ps(inv_scale));
+        __m512 p = _mm512_loadu_ps(params + i);
+        if (!adamw && weight_decay > 0.f)
+            g = _mm512_fmadd_ps(_mm512_set1_ps(weight_decay), p, g);
+        __m512 m = _mm512_loadu_ps(exp_avg + i);
+        __m512 v = _mm512_loadu_ps(exp_avg_sq + i);
+        m = _mm512_fmadd_ps(_mm512_set1_ps(beta1), m,
+                            _mm512_mul_ps(_mm512_set1_ps(1.f - beta1), g));
+        v = _mm512_fmadd_ps(_mm512_set1_ps(beta2), v,
+                            _mm512_mul_ps(_mm512_set1_ps(1.f - beta2),
+                                          _mm512_mul_ps(g, g)));
+        __m512 denom = _mm512_add_ps(
+            _mm512_sqrt_ps(_mm512_div_ps(v, _mm512_set1_ps(bc2))),
+            _mm512_set1_ps(eps));
+        __m512 upd = _mm512_div_ps(_mm512_div_ps(m, _mm512_set1_ps(bc1)),
+                                   denom);
+        if (adamw && weight_decay > 0.f)
+            upd = _mm512_fmadd_ps(_mm512_set1_ps(weight_decay), p, upd);
+        p = _mm512_fnmadd_ps(_mm512_set1_ps(lr), upd, p);
+        _mm512_storeu_ps(params + i, p);
+        _mm512_storeu_ps(exp_avg + i, m);
+        _mm512_storeu_ps(exp_avg_sq + i, v);
+#elif defined(__AVX2__)
+        __m256 g = _mm256_mul_ps(_mm256_loadu_ps(grads + i),
+                                 _mm256_set1_ps(inv_scale));
+        __m256 p = _mm256_loadu_ps(params + i);
+        if (!adamw && weight_decay > 0.f)
+            g = _mm256_fmadd_ps(_mm256_set1_ps(weight_decay), p, g);
+        __m256 m = _mm256_loadu_ps(exp_avg + i);
+        __m256 v = _mm256_loadu_ps(exp_avg_sq + i);
+        m = _mm256_fmadd_ps(_mm256_set1_ps(beta1), m,
+                            _mm256_mul_ps(_mm256_set1_ps(1.f - beta1), g));
+        v = _mm256_fmadd_ps(_mm256_set1_ps(beta2), v,
+                            _mm256_mul_ps(_mm256_set1_ps(1.f - beta2),
+                                          _mm256_mul_ps(g, g)));
+        __m256 denom = _mm256_add_ps(
+            _mm256_sqrt_ps(_mm256_div_ps(v, _mm256_set1_ps(bc2))),
+            _mm256_set1_ps(eps));
+        __m256 upd = _mm256_div_ps(_mm256_div_ps(m, _mm256_set1_ps(bc1)),
+                                   denom);
+        if (adamw && weight_decay > 0.f)
+            upd = _mm256_fmadd_ps(_mm256_set1_ps(weight_decay), p, upd);
+        p = _mm256_fnmadd_ps(_mm256_set1_ps(lr), upd, p);
+        _mm256_storeu_ps(params + i, p);
+        _mm256_storeu_ps(exp_avg + i, m);
+        _mm256_storeu_ps(exp_avg_sq + i, v);
+#else
+        adam_scalar(params, grads, exp_avg, exp_avg_sq, i, i + W, lr, beta1,
+                    beta2, eps, weight_decay, adamw, bc1, bc2, inv_scale);
+#endif
+    }
+    adam_scalar(params, grads, exp_avg, exp_avg_sq, vec_end, n, lr, beta1,
+                beta2, eps, weight_decay, adamw, bc1, bc2, inv_scale);
+}
+
+// fp32 -> bf16 (round-to-nearest-even) copy for device transfer — the
+// reference's fused fp16 copy-back (cpu_adam.cpp adam_update_copy),
+// retargeted at the TPU-native dtype.
+void ds_fp32_to_bf16(const float* src, std::uint16_t* dst, std::int64_t n) {
+#pragma omp parallel for schedule(static)
+    for (std::int64_t i = 0; i < n; ++i) {
+        std::uint32_t bits;
+        __builtin_memcpy(&bits, src + i, 4);
+        std::uint32_t lsb = (bits >> 16) & 1u;
+        bits += 0x7fffu + lsb;   // round to nearest even
+        dst[i] = (std::uint16_t)(bits >> 16);
+    }
+}
+
+// fp32 -> fp16 copy (parity with the reference's fp16 flow).
+void ds_fp32_to_fp16(const float* src, std::uint16_t* dst, std::int64_t n) {
+#pragma omp parallel for schedule(static)
+    for (std::int64_t i = 0; i < n; ++i) {
+#if defined(__F16C__)
+        dst[i] = _cvtss_sh(src[i], _MM_FROUND_TO_NEAREST_INT);
+#else
+        // minimal scalar fp32->fp16 with round-to-nearest
+        std::uint32_t b;
+        __builtin_memcpy(&b, src + i, 4);
+        std::uint32_t sign = (b >> 16) & 0x8000u;
+        std::int32_t exp = (std::int32_t)((b >> 23) & 0xff) - 127 + 15;
+        std::uint32_t mant = b & 0x7fffffu;
+        std::uint16_t h;
+        if (exp <= 0) h = (std::uint16_t)sign;                 // flush
+        else if (exp >= 31) h = (std::uint16_t)(sign | 0x7c00); // inf
+        else h = (std::uint16_t)(sign | (exp << 10) | (mant >> 13));
+        dst[i] = h;
+#endif
+    }
+}
+
+int ds_simd_width(void) {
+#if defined(__AVX512F__)
+    return 16;
+#elif defined(__AVX2__)
+    return 8;
+#else
+    return 1;
+#endif
+}
+
+}  // extern "C"

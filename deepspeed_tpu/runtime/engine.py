@@ -303,7 +303,8 @@ class DeepSpeedEngine:
             return 0
         import jax
 
-        return int(jax.device_get(self.state.skipped_steps))
+        return int(jax.device_get(self.state.skipped_steps)) \
+            + getattr(self, "_host_skipped", 0)
 
     def get_lr(self):
         return [self._current_lr()]
@@ -330,6 +331,13 @@ class DeepSpeedEngine:
         if max_grad_norm and not self._config.gradient_clipping:
             self._config.gradient_clipping = max_grad_norm
         if name in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER):
+            if self.zero_cpu_offload():
+                # ZeRO-Offload: optimizer state + step on the host
+                # (reference engine.py:599-614 picks DeepSpeedCPUAdam)
+                from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+
+                params.setdefault("adamw_mode", name == ADAMW_OPTIMIZER)
+                return DeepSpeedCPUAdam(**params)
             from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
 
             params.setdefault("adam_w_mode", name == ADAMW_OPTIMIZER)
@@ -415,6 +423,16 @@ class DeepSpeedEngine:
         # accum: ZeRO-2 shards gradients; otherwise keep with param layout
         accum_sh = ns(zero_spec) if stage >= 2 else param_sh
 
+        if self._offload:
+            # optimizer state lives on host; nothing to shard
+            self._shardings = TrainState(
+                step=rep, micro_step=rep, params=param_sh, opt_state=(),
+                master=None, accum=accum_sh,
+                scaler=(LossScaleState(rep, rep, rep, rep)
+                        if self._use_loss_scaler() else None),
+                skipped_steps=rep, rng=rep)
+            self._batch_sharding_cache = {}
+            return self._shardings
         opt_state_template = jax.eval_shape(self.optimizer.init_state, params_template)
         flat_opt, opt_def = jax.tree_util.tree_flatten(opt_state_template)
         if hasattr(self.optimizer, "state_spec"):
@@ -456,9 +474,88 @@ class DeepSpeedEngine:
     def _use_loss_scaler(self):
         return self.fp16_enabled()
 
+    @property
+    def _offload(self):
+        return getattr(self.optimizer, "needs_host_state", False)
+
+    def _ensure_state_offload(self, batch):
+        """ZeRO-Offload state: device params/accum, HOST fp32 master +
+        optimizer moments (reference stage2.py:349-365 cpu_offload branch)."""
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.time()
+        dev_batch = self._shard_batch(batch)
+        init_rng, state_rng = jax.random.split(self._init_rng)
+        params_template = jax.eval_shape(
+            lambda r, b: self.module.init(r, b), init_rng, dev_batch)
+        self._build_shardings(jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, jnp.float32),
+            params_template))
+        param_sh = self._shardings.params
+
+        # init on host, keep fp32 master there, push compute params down
+        try:
+            host_dev = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:  # pragma: no cover
+            host_dev = jax.local_devices()[0]
+        with jax.default_device(host_dev):
+            params_f32 = self.module.init(init_rng, batch)
+        host_master = jax.tree_util.tree_map(
+            lambda l: np.ascontiguousarray(np.asarray(jax.device_get(l),
+                                                      dtype=np.float32)),
+            params_f32)
+        self._host_master_flat, self._host_treedef = \
+            jax.tree_util.tree_flatten(host_master)
+        self._host_opt = self.optimizer.init_state(host_master)
+
+        with jax.set_mesh(self.mesh):
+            params = jax.tree_util.tree_map(
+                lambda l, sh: jax.device_put(
+                    np.asarray(l, dtype=self.compute_dtype), sh),
+                host_master, param_sh)
+            accum_jit = jax.jit(
+                lambda p: jax.tree_util.tree_map(
+                    lambda l: jnp.zeros(l.shape, jnp.float32), p),
+                out_shardings=self._shardings.accum)
+            accum = accum_jit(params)
+
+        # scaler lives in device state (the micro fn reads loss_scale in
+        # jit); the update decision runs host-side at step time
+        scaler = None
+        args = self._config.dynamic_loss_scale_args or {}
+        if self._use_loss_scaler():
+            if self._config.loss_scale and self._config.loss_scale > 0:
+                scaler = make_loss_scale_state(self._config.loss_scale)
+                self._off_dynamic = False
+            else:
+                scaler = make_loss_scale_state(
+                    args.get("init_scale", self._config.initial_dynamic_scale),
+                    delayed_shift=args.get("delayed_shift", 1))
+                self._off_dynamic = True
+        else:
+            self._off_dynamic = False
+        self._off_scale_window = args.get("scale_window", 1000)
+        self._off_min_scale = args.get("min_scale", 1.0)
+        self._off_good_steps = 0
+        self._host_skipped = 0
+
+        self.state = TrainState(
+            step=jnp.int32(0), micro_step=jnp.int32(0), params=params,
+            opt_state=(), master=None, accum=accum, scaler=scaler,
+            skipped_steps=jnp.int32(0), rng=state_rng)
+        n_params = sum(l.size for l in self._host_master_flat)
+        log_dist(
+            f"Initialized ZeRO-Offload state: {n_params/1e6:.1f}M params "
+            f"(fp32 master + moments on host, "
+            f"{'AVX' if getattr(self.optimizer, 'using_native', False) else 'numpy'} "
+            f"Adam) in {time.time()-t0:.1f}s", ranks=[0])
+
     def _ensure_state(self, batch):
         if self.state is not None:
             return
+        if self._offload:
+            return self._ensure_state_offload(batch)
         import jax
         import jax.numpy as jnp
 
@@ -645,6 +742,16 @@ class DeepSpeedEngine:
 
         sh = self._shardings
         micro = self._make_micro_fn()
+        if self._offload:
+            # apply runs on host (CPU Adam); only the micro step is jitted
+            self._jit_micro = jax.jit(micro, out_shardings=(sh, None))
+            import jax.numpy as jnp
+
+            # zeros_like, not a*0: accum may hold Inf/NaN after an overflow
+            self._jit_zero_accum = jax.jit(
+                lambda a: jax.tree_util.tree_map(jnp.zeros_like, a),
+                donate_argnums=(0,), out_shardings=sh.accum)
+            return
         apply_ = self._make_apply_fn()
 
         # NOTE: the micro step does NOT donate its input state — backward()
@@ -724,7 +831,98 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).stop()
 
+    def _take_model_step_offload(self):
+        """Host-driven step: grads -> host, AVX Adam on the fp32 master,
+        compute-dtype params -> device (reference stage2.py:1525-1536)."""
+        import jax
+
+        lr = self._advance_lr()
+        state = self.state
+        accum = state.accum
+        if jax.process_count() > 1:
+            # cross-host ZeRO shards are not addressable from this process;
+            # reshard to replicated before the host fetch (same pattern as
+            # save_checkpoint; per-shard host update is the planned
+            # optimization)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(self.mesh, P())
+            rep_tree = jax.tree_util.tree_map(lambda _: rep, accum)
+            with jax.set_mesh(self.mesh):
+                accum = jax.jit(lambda a: a, out_shardings=rep_tree)(accum)
+        grads_flat = [np.asarray(jax.device_get(g), dtype=np.float32)
+                      for g in jax.tree_util.tree_leaves(accum)]
+        scale = float(jax.device_get(state.scaler.loss_scale)) \
+            if state.scaler is not None else 1.0
+        finite = all(np.isfinite(g).all() for g in grads_flat)
+
+        if finite:
+            clip = self.gradient_clipping()
+            gnorm = float(np.sqrt(sum(float((g.astype(np.float64) ** 2).sum())
+                                      for g in grads_flat))) / scale
+            clip_factor = min(1.0, clip / (gnorm + 1e-6)) if clip else 1.0
+            # ds_adam_step divides grads by grad_scale: fold unscale + clip
+            self._host_opt = self.optimizer.step(
+                self._host_master_flat, grads_flat, self._host_opt, lr=lr,
+                grad_scale=scale / clip_factor)
+            # cast on host via the C++ converter, then one transfer
+            host_params = self.optimizer.cast_to(
+                self._host_master_flat, str(jax.numpy.dtype(self.compute_dtype)))
+            params_tree = jax.tree_util.tree_unflatten(
+                self._host_treedef, host_params)
+            with jax.set_mesh(self.mesh):
+                new_params = jax.tree_util.tree_map(
+                    lambda l, sh: jax.device_put(l, sh), params_tree,
+                    self._shardings.params)
+            self.state = state._replace(params=new_params)
+            self._last_grad_norm = gnorm
+            self._off_good_steps += 1
+            self._off_overflows = 0
+            new_scale = scale
+            if self._off_dynamic and \
+                    self._off_good_steps % self._off_scale_window == 0:
+                new_scale = scale * 2.0
+        else:
+            self._host_skipped += 1
+            self._off_good_steps = 0
+            self._last_grad_norm = 0.0
+            new_scale = scale
+            if self._off_dynamic:
+                # hysteresis parity with DynamicLossScaler.delayed_shift:
+                # halve only after `delayed_shift` consecutive overflows
+                self._off_overflows = getattr(self, "_off_overflows", 0) + 1
+                shift = (self._config.dynamic_loss_scale_args or {}).get(
+                    "delayed_shift", 1)
+                if self._off_overflows >= shift:
+                    new_scale = max(self._off_min_scale, scale / 2.0)
+                    self._off_overflows = 0
+            log_dist(f"ZeRO-Offload: OVERFLOW, skipping step "
+                     f"{self.global_steps + 1}, scale -> {new_scale:g}",
+                     ranks=[0])
+
+        import jax.numpy as jnp
+
+        with jax.set_mesh(self.mesh):
+            zero_accum = self._jit_zero_accum(self.state.accum)
+        scaler = self.state.scaler
+        if scaler is not None and new_scale != scale:
+            scaler = make_loss_scale_state(
+                new_scale,
+                delayed_shift=(self._config.dynamic_loss_scale_args or {})
+                .get("delayed_shift", 1))
+        self.state = self.state._replace(
+            accum=zero_accum, micro_step=jnp.int32(0),
+            step=self.state.step + 1, scaler=scaler)
+        self.global_steps += 1
+        self._last_metrics = {"overflow": not finite,
+                              "grad_norm": getattr(self, "_last_grad_norm", 0.0),
+                              "loss_scale": scale}
+        if self.global_steps % self.steps_per_print() == 0:
+            self._report_progress(self.global_steps)
+
     def _take_model_step(self):
+        if self._offload:
+            return self._take_model_step_offload()
         lr = self._advance_lr()
         import jax
         import jax.numpy as jnp
@@ -766,10 +964,25 @@ class DeepSpeedEngine:
             batch = _stack_batches(micros)
         self._ensure_state(_first_micro(batch))
         self._compile()
-        dev = self._shard_stacked_batch(batch)
-        lr = self._advance_lr()
         import jax
         import jax.numpy as jnp
+
+        if self._offload:
+            # apply runs on host: micro-loop on device, then the CPU step
+            self.tput_timer.start()
+            losses = []
+            with jax.set_mesh(self.mesh):
+                for i in range(gas):
+                    dev_micro = self._shard_batch(_micro_at(batch, i))
+                    self.state, loss = self._jit_micro(self.state, dev_micro)
+                    losses.append(loss)
+            self.micro_steps += gas
+            self._take_model_step_offload()  # reports progress itself
+            self.tput_timer.stop()
+            # mean over micro-batches, matching the fused path's metric
+            return jnp.mean(jnp.stack(losses))
+        dev = self._shard_stacked_batch(batch)
+        lr = self._advance_lr()
 
         self.tput_timer.start()
         with jax.set_mesh(self.mesh):
@@ -861,6 +1074,12 @@ class DeepSpeedEngine:
             flat, treedef = jax.tree_util.tree_flatten(host_state)
             np.savez(os.path.join(path, "model_states.npz"),
                      **leaves_to_npz_dict(flat))
+            if self._offload:
+                np.savez(os.path.join(path, "offload_states.npz"),
+                         **leaves_to_npz_dict(
+                             self._host_master_flat + self._host_opt["m"]
+                             + self._host_opt["v"]),
+                         opt_step=self._host_opt["step"])
             meta = {
                 "global_steps": self.global_steps,
                 "micro_steps": self.micro_steps,
@@ -910,6 +1129,24 @@ class DeepSpeedEngine:
                     zip(jax.tree_util.tree_leaves(host_state), sh_flat)]
         self.state = jax.tree_util.tree_unflatten(treedef, dev_flat)
 
+        if self._offload:
+            off = np.load(os.path.join(path, "offload_states.npz"))
+            leaves = npz_dict_to_leaves(off)
+            n = len(self._host_master_flat)
+            assert len(leaves) == 3 * n
+            self._host_master_flat = [np.ascontiguousarray(l)
+                                      for l in leaves[:n]]
+            self._host_opt["m"] = [np.ascontiguousarray(l)
+                                   for l in leaves[n:2 * n]]
+            self._host_opt["v"] = [np.ascontiguousarray(l)
+                                   for l in leaves[2 * n:]]
+            self._host_opt["step"] = int(off["opt_step"])
+            # host-side skip counter: meta holds device + host total; the
+            # device part restored with the state leaves above
+            device_skips = int(jax.device_get(self.state.skipped_steps))
+            self._host_skipped = max(
+                0, int(meta.get("skipped_steps", 0)) - device_skips)
+
         self.global_steps = meta["global_steps"]
         self.micro_steps = meta["micro_steps"]
         # skipped_steps restores with the device state (a TrainState leaf)
@@ -933,6 +1170,10 @@ def _stack_batches(micros):
 
 
 def _first_micro(batch):
+    return _micro_at(batch, 0)
+
+
+def _micro_at(batch, i):
     if isinstance(batch, dict):
-        return {k: v[0] for k, v in batch.items()}
-    return batch[0]
+        return {k: v[i] for k, v in batch.items()}
+    return batch[i]

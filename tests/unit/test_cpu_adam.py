@@ -1,0 +1,210 @@
+"""CPU Adam (ZeRO-Offload) tests — reference tests/unit/test_cpu_adam.py
+pattern: the native kernel vs an independent Adam implementation, plus the
+engine's offload flow end-to-end."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+
+
+def torch_style_adam(p, g, m, v, step, lr, beta1, beta2, eps, wd, adamw):
+    """Independent reference (torch.optim.Adam/AdamW semantics), float64."""
+    p, g, m, v = (x.astype(np.float64) for x in (p, g, m, v))
+    if not adamw and wd > 0:
+        g = g + wd * p
+    m = beta1 * m + (1 - beta1) * g
+    v = beta2 * v + (1 - beta2) * g * g
+    mh = m / (1 - beta1 ** step)
+    vh = v / (1 - beta2 ** step)
+    upd = mh / (np.sqrt(vh) + eps)
+    if adamw and wd > 0:
+        upd = upd + wd * p
+    return p - lr * upd, m, v
+
+
+@pytest.mark.parametrize("n", [7, 64, 1000, 4099])  # odd sizes hit SIMD tails
+@pytest.mark.parametrize("adamw,wd", [(True, 0.01), (False, 0.01),
+                                      (True, 0.0)])
+def test_cpu_adam_matches_reference(n, adamw, wd):
+    rng = np.random.default_rng(0)
+    opt = DeepSpeedCPUAdam(lr=0.01, weight_decay=wd, adamw_mode=adamw)
+    p = rng.standard_normal(n).astype(np.float32)
+    params = {"w": p.copy()}
+    state = opt.init_state(params)
+    leaves = [np.ascontiguousarray(p.copy())]
+
+    p_ref = p.copy()
+    m_ref = np.zeros(n)
+    v_ref = np.zeros(n)
+    for step in range(1, 6):
+        g = rng.standard_normal(n).astype(np.float32)
+        opt.step(leaves, [g], state)
+        p_ref, m_ref, v_ref = torch_style_adam(
+            p_ref, g, m_ref, v_ref, step, 0.01, 0.9, 0.999, 1e-8, wd, adamw)
+        np.testing.assert_allclose(leaves[0], p_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(state["m"][0], m_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(state["v"][0], v_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_cpu_adam_grad_scale_fused_unscale():
+    rng = np.random.default_rng(1)
+    n = 256
+    p0 = rng.standard_normal(n).astype(np.float32)
+    g = rng.standard_normal(n).astype(np.float32)
+
+    opt1 = DeepSpeedCPUAdam(lr=0.01)
+    s1 = opt1.init_state({"w": p0})
+    l1 = [np.ascontiguousarray(p0.copy())]
+    opt1.step(l1, [g * 128.0], s1, grad_scale=128.0)
+
+    opt2 = DeepSpeedCPUAdam(lr=0.01)
+    s2 = opt2.init_state({"w": p0})
+    l2 = [np.ascontiguousarray(p0.copy())]
+    opt2.step(l2, [g], s2)
+    np.testing.assert_allclose(l1[0], l2[0], rtol=1e-5, atol=1e-6)
+
+
+def test_native_matches_numpy_fallback():
+    opt_native = DeepSpeedCPUAdam(lr=0.02, weight_decay=0.01)
+    if not opt_native.using_native:
+        pytest.skip("no native toolchain")
+    opt_np = DeepSpeedCPUAdam(lr=0.02, weight_decay=0.01)
+    opt_np._lib = None
+    rng = np.random.default_rng(2)
+    p0 = rng.standard_normal(513).astype(np.float32)
+    l1 = [np.ascontiguousarray(p0.copy())]
+    l2 = [np.ascontiguousarray(p0.copy())]
+    s1 = opt_native.init_state({"w": p0})
+    s2 = opt_np.init_state({"w": p0})
+    for _ in range(4):
+        g = rng.standard_normal(513).astype(np.float32)
+        opt_native.step(l1, [g], s1)
+        opt_np.step(l2, [g], s2)
+    np.testing.assert_allclose(l1[0], l2[0], rtol=1e-5, atol=1e-6)
+
+
+def test_bf16_cast_round_to_nearest_even():
+    opt = DeepSpeedCPUAdam()
+    x = np.asarray([1.0, 1.0 + 2 ** -8, -3.14159, 65504.0, 1e-40],
+                   np.float32)
+    out = opt.cast_to([x], "bfloat16")[0]
+    import ml_dtypes
+
+    exp = x.astype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(out.view(np.uint16), exp.view(np.uint16))
+
+
+def test_engine_offload_e2e():
+    """cpu_offload config: fp32 master+moments on host, loss decreases,
+    results match the non-offload engine."""
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel, random_dataloader
+
+    def run(offload):
+        model = SimpleModel(hidden_dim=16)
+        cfg = {
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+            "zero_optimization": {"stage": 2, "cpu_offload": offload},
+            "steps_per_print": 100,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                                   config_params=cfg)
+        data = random_dataloader(16, 64, 8, seed=0)
+        losses = []
+        for _ in range(8):
+            batch = next(data)
+            loss = engine(batch)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(jax.device_get(loss)))
+        return engine, losses
+
+    _, base = run(offload=False)
+    engine, off = run(offload=True)
+    assert engine._offload
+    assert np.isfinite(off).all() and off[-1] < off[0]
+    np.testing.assert_allclose(base, off, rtol=2e-3, atol=1e-4)
+
+
+def test_engine_offload_checkpoint_roundtrip(tmp_path):
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel, random_dataloader
+
+    def make():
+        model = SimpleModel(hidden_dim=16)
+        cfg = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+               "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+               "zero_optimization": {"stage": 2, "cpu_offload": True},
+               "steps_per_print": 100}
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                                   config_params=cfg)
+        return engine
+
+    engine = make()
+    data = random_dataloader(16, 64, 8, seed=0)
+    for _ in range(3):
+        batch = next(data)
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+    engine.save_checkpoint(str(tmp_path), tag="o1")
+
+    engine2 = make()
+    batch = next(data)
+    loss = engine2(batch)
+    engine2.backward(loss)
+    engine2.step()
+    engine2.load_checkpoint(str(tmp_path), tag="o1")
+    for a, b in zip(engine._host_master_flat, engine2._host_master_flat):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(engine._host_opt["m"], engine2._host_opt["m"]):
+        np.testing.assert_array_equal(a, b)
+    assert engine2._host_opt["step"] == engine._host_opt["step"]
+
+    # both continue identically
+    batch = next(data)
+    l1 = float(jax.device_get(engine(batch)))
+    engine.backward(l1)
+    l2 = float(jax.device_get(engine2(batch)))
+    engine2.backward(l2)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_engine_offload_fp16_overflow_skips():
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel
+
+    model = SimpleModel(hidden_dim=16)
+    cfg = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+           "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+           "fp16": {"enabled": True, "initial_scale_power": 4},
+           "zero_optimization": {"stage": 2, "cpu_offload": True},
+           "steps_per_print": 100}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                               config_params=cfg)
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.standard_normal((8, 16)).astype(np.float32),
+             "y": rng.integers(0, 4, (8,)).astype(np.int32)}
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    scale0 = float(jax.device_get(engine.state.scaler.loss_scale))
+    # poison batches to force overflow; default hysteresis (delayed_shift=2)
+    # halves the scale only on the SECOND consecutive overflow
+    bad = {"x": np.full((8, 16), np.inf, np.float32),
+           "y": np.zeros((8,), np.int32)}
+    loss = engine(bad)
+    engine.backward(loss)
+    engine.step()
+    assert engine.skipped_steps == 1
+    assert float(jax.device_get(engine.state.scaler.loss_scale)) == scale0
+    loss = engine(bad)
+    engine.backward(loss)
+    engine.step()
+    assert engine.skipped_steps == 2
+    scale1 = float(jax.device_get(engine.state.scaler.loss_scale))
+    assert scale1 <= scale0 / 2

@@ -140,16 +140,30 @@ void ds_fp32_to_fp16(const float* src, std::uint16_t* dst, std::int64_t n) {
 #if defined(__F16C__)
         dst[i] = _cvtss_sh(src[i], _MM_FROUND_TO_NEAREST_INT);
 #else
-        // minimal scalar fp32->fp16 with round-to-nearest
+        // scalar fp32->fp16, round-to-nearest-even, NaN-preserving
         std::uint32_t b;
         __builtin_memcpy(&b, src + i, 4);
         std::uint32_t sign = (b >> 16) & 0x8000u;
-        std::int32_t exp = (std::int32_t)((b >> 23) & 0xff) - 127 + 15;
-        std::uint32_t mant = b & 0x7fffffu;
+        std::uint32_t absb = b & 0x7fffffffu;
         std::uint16_t h;
-        if (exp <= 0) h = (std::uint16_t)sign;                 // flush
-        else if (exp >= 31) h = (std::uint16_t)(sign | 0x7c00); // inf
-        else h = (std::uint16_t)(sign | (exp << 10) | (mant >> 13));
+        if (absb >= 0x7f800000u) {            // inf or nan
+            h = (std::uint16_t)(sign | 0x7c00u |
+                                ((absb > 0x7f800000u) ? 0x200u : 0));
+        } else if (absb >= 0x477ff000u) {     // overflows fp16 -> inf
+            h = (std::uint16_t)(sign | 0x7c00u);
+        } else {
+            std::int32_t exp = (std::int32_t)((absb >> 23)) - 127 + 15;
+            std::uint32_t mant = absb & 0x7fffffu;
+            if (exp <= 0) {
+                h = (std::uint16_t)sign;      // flush subnormals
+            } else {
+                std::uint32_t val = (std::uint32_t)(exp << 10) | (mant >> 13);
+                std::uint32_t rem = mant & 0x1fffu;       // dropped 13 bits
+                if (rem > 0x1000u || (rem == 0x1000u && (val & 1u)))
+                    ++val;                    // round to nearest even
+                h = (std::uint16_t)(sign | val);
+            }
+        }
         dst[i] = h;
 #endif
     }

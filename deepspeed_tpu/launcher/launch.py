@@ -1,0 +1,75 @@
+"""Per-node launcher — spawns the training process and babysits it.
+
+Reference behavior: deepspeed/launcher/launch.py:67-171 (decode base64
+world-info, set RANK/LOCAL_RANK/WORLD_SIZE/MASTER_*, one process per GPU,
+signal-propagating babysitter).
+
+TPU adaptation: ONE training process per host (it owns every local chip),
+so rank == node_rank and world_size == number of hosts. LOCAL_RANK is set
+to 0 for script compatibility.
+"""
+import argparse
+import base64
+import json
+import os
+import signal
+import subprocess
+import sys
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--world_info", type=str, required=True,
+                        help="base64 json {host: [slot...]}")
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--master_addr", type=str, default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def decode_world_info(encoded):
+    return json.loads(base64.urlsafe_b64decode(encoded.encode()).decode())
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    hosts = list(world_info.keys())
+    world_size = len(hosts)      # one process per host on TPU
+    node_rank = args.node_rank
+    assert 0 <= node_rank < max(1, world_size), \
+        f"node_rank {node_rank} out of range for {world_size} hosts"
+
+    env = os.environ.copy()
+    env["RANK"] = str(node_rank)
+    env["LOCAL_RANK"] = "0"
+    env["WORLD_SIZE"] = str(world_size)
+    env["MASTER_ADDR"] = args.master_addr
+    env["MASTER_PORT"] = str(args.master_port)
+    env["DSTPU_NODE_SLOTS"] = str(len(world_info.get(hosts[node_rank], [0]))
+                                  if world_size else 1)
+
+    cmd = [sys.executable, "-u", args.user_script] + args.user_args
+    logger.info(f"launch: rank={node_rank}/{world_size} cmd={cmd}")
+    process = subprocess.Popen(cmd, env=env)
+
+    # babysitter: forward signals, kill on child failure
+    # (reference launch.py:131-165)
+    def sig_handler(signum, frame):
+        process.send_signal(signum)
+
+    signal.signal(signal.SIGTERM, sig_handler)
+    signal.signal(signal.SIGINT, sig_handler)
+    process.wait()
+    if process.returncode != 0:
+        logger.error(f"training process exited with code "
+                     f"{process.returncode}")
+    return process.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,227 @@
+"""`ds` runner — multi-host TPU launch front-end.
+
+Reference behavior: deepspeed/launcher/runner.py:115-360 (hostfile parse
+`hostname slots=N`, --include/--exclude filters, base64 world-info, pdsh/
+mpirun fan-out, .deepspeed_env forwarding).
+
+TPU adaptation: ONE process per host owns all local chips (SURVEY §2.10) —
+"slots" counts chips for resource accounting, but the spawned world has one
+rank per host. Rendezvous is MASTER_ADDR/MASTER_PORT ->
+jax.distributed.initialize (utils/distributed.py).
+"""
+import argparse
+import base64
+import json
+import os
+import subprocess
+import sys
+from collections import OrderedDict
+from shlex import quote
+
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["PYTHONPATH", "PATH", "LD_LIBRARY_PATH", "JAX_PLATFORMS",
+               "XLA_FLAGS", "LIBTPU_INIT_ARGS", "TPU_NAME"]
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+DEEPSPEED_ENVIRONMENT_PATHS = [".", os.path.expanduser("~")]
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu launcher: run a training script across "
+                    "TPU hosts")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile of 'hostname slots=N' lines")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="host[:slot[,slot]][@host...] inclusion filter")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="same syntax exclusion filter")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="limit to first N nodes")
+    parser.add_argument("--num_gpus", "--num_chips", type=int, default=-1,
+                        dest="num_gpus", help="chips per node to use")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--launcher", type=str, default="pdsh",
+                        choices=["pdsh", "openmpi", "ssh"],
+                        help="multi-node fan-out backend")
+    parser.add_argument("--launcher_args", type=str, default="")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path):
+    """hostfile -> OrderedDict{hostname: slot_count}
+    (reference runner.py:115-145)."""
+    if not os.path.isfile(hostfile_path):
+        logger.warning(f"Unable to find hostfile at {hostfile_path}; "
+                       f"proceeding with localhost only")
+        return None
+    resource_pool = OrderedDict()
+    with open(hostfile_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                key, slot_count = slots.split("=")
+                if key != "slots":
+                    raise ValueError(key)
+                slot_count = int(slot_count)
+            except ValueError:
+                raise ValueError(
+                    f"Hostfile is not formatted correctly, unable to parse "
+                    f"line: {line!r} (expected 'hostname slots=N')")
+            if hostname in resource_pool:
+                raise ValueError(
+                    f"Hostfile contains duplicate hosts: {hostname}")
+            resource_pool[hostname] = slot_count
+    if not resource_pool:
+        raise ValueError("Hostfile is empty or formatted incorrectly")
+    return resource_pool
+
+
+def _parse_filter(spec):
+    """'host1:0,1@host2' -> {host: [slots] or []} (reference :157-196)."""
+    mapping = {}
+    for part in spec.split("@"):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            host, slots = part.split(":")
+            mapping[host] = [int(s) for s in slots.split(",")]
+        else:
+            mapping[part] = []
+    return mapping
+
+
+def parse_resource_filter(host_info, include_str="", exclude_str=""):
+    """Apply --include/--exclude (reference runner.py:146-246).
+    Only one of the two may be set."""
+    if include_str and exclude_str:
+        raise ValueError("include_str and exclude_str are mutually exclusive")
+    if not include_str and not exclude_str:
+        return host_info
+
+    filtered = OrderedDict()
+    if include_str:
+        for host, slots in _parse_filter(include_str).items():
+            if host not in host_info:
+                raise ValueError(f"Hostname '{host}' not found in hostfile")
+            for s in slots:
+                if s >= host_info[host]:
+                    raise ValueError(f"No slot '{s}' specified on host "
+                                     f"'{host}'")
+            filtered[host] = len(slots) if slots else host_info[host]
+        return filtered
+
+    excl = _parse_filter(exclude_str)
+    for host, count in host_info.items():
+        if host not in excl:
+            filtered[host] = count
+            continue
+        slots = excl[host]
+        if not slots:
+            continue   # whole host excluded
+        for s in slots:
+            if s >= count:
+                raise ValueError(f"No slot '{s}' specified on host '{host}'")
+        remaining = count - len(set(slots))
+        if remaining > 0:
+            filtered[host] = remaining
+    if not filtered:
+        raise ValueError("No hosts left after exclusion filter")
+    return filtered
+
+
+def encode_world_info(resource_pool):
+    world_info = {host: list(range(slots))
+                  for host, slots in resource_pool.items()}
+    return base64.urlsafe_b64encode(
+        json.dumps(world_info).encode()).decode()
+
+
+def collect_env_exports():
+    """EXPORT_ENVS + .deepspeed_env entries (reference :296-320)."""
+    exports = {}
+    for var in EXPORT_ENVS:
+        if var in os.environ:
+            exports[var] = os.environ[var]
+    for path in DEEPSPEED_ENVIRONMENT_PATHS:
+        env_file = os.path.join(path, DEEPSPEED_ENVIRONMENT_NAME)
+        if os.path.isfile(env_file):
+            with open(env_file) as f:
+                for line in f:
+                    line = line.strip()
+                    if line and "=" in line:
+                        key, val = line.split("=", 1)
+                        exports[key] = val
+    return exports
+
+
+def main(args=None):
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    if resource_pool is None:
+        # single node: spawn launch.py locally
+        resource_pool = OrderedDict(localhost=args.num_gpus
+                                    if args.num_gpus > 0 else 1)
+        active = resource_pool
+        multi_node = False
+    else:
+        active = parse_resource_filter(resource_pool, args.include,
+                                       args.exclude)
+        if args.num_nodes > 0:
+            active = OrderedDict(list(active.items())[:args.num_nodes])
+        multi_node = args.force_multi or len(active) > 1
+
+    master_addr = args.master_addr
+    if not master_addr:
+        if multi_node:
+            first = next(iter(active))
+            try:
+                out = subprocess.run(
+                    ["ssh", first, "hostname", "-I"], capture_output=True,
+                    text=True, timeout=30, check=True)
+                master_addr = out.stdout.split()[0]
+            except (OSError, subprocess.SubprocessError):
+                master_addr = first
+        else:
+            master_addr = "127.0.0.1"
+
+    world_info = encode_world_info(active)
+    launch_cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+                  f"--world_info={world_info}",
+                  f"--master_addr={master_addr}",
+                  f"--master_port={args.master_port}"]
+
+    if not multi_node:
+        cmd = launch_cmd + ["--node_rank=0", args.user_script] + args.user_args
+        logger.info(f"cmd = {' '.join(map(str, cmd))}")
+        result = subprocess.run(cmd)
+        return result.returncode
+
+    from deepspeed_tpu.launcher.multinode_runner import (OpenMPIRunner,
+                                                         PDSHRunner, SSHRunner)
+
+    runner_cls = {"pdsh": PDSHRunner, "openmpi": OpenMPIRunner,
+                  "ssh": SSHRunner}[args.launcher]
+    runner = runner_cls(args, world_info)
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend {args.launcher} not available "
+                           f"on this host")
+    env = collect_env_exports()
+    cmd = runner.get_cmd(env, active)
+    logger.info(f"cmd = {' '.join(map(str, cmd))}")
+    result = subprocess.run(cmd, env={**os.environ, **env})
+    return result.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,12 +66,16 @@ class OpBuilder:
             os.path.getmtime(s) > os.path.getmtime(so) for s in sources)
         if stale:
             os.makedirs(_CACHE_DIR, exist_ok=True)
+            # unique temp per process: concurrent builders (multi-host NFS
+            # home, parallel pytest) must not interleave writes; os.replace
+            # promotes atomically, last writer wins
+            tmp = f"{so}.tmp.{os.getpid()}"
             cmd = (["g++", "-O3", "-shared", "-fPIC", "-fopenmp"]
                    + self.cpu_arch_flags() + self.EXTRA_FLAGS
-                   + sources + ["-o", so + ".tmp"])
+                   + sources + ["-o", tmp])
             try:
                 subprocess.run(cmd, capture_output=True, check=True, text=True)
-                os.replace(so + ".tmp", so)
+                os.replace(tmp, so)
                 logger.info(f"op '{self.NAME}': compiled {so}")
             except subprocess.CalledProcessError as e:
                 logger.warning(f"op '{self.NAME}': compile failed "

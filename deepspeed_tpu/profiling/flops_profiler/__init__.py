@@ -1,0 +1,3 @@
+from deepspeed_tpu.profiling.flops_profiler.profiler import (
+    FlopsProfiler, analyze_jit, duration_to_string, flops_to_string,
+    get_model_profile, macs_to_string, number_to_string, params_to_string)

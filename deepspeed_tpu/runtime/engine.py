@@ -520,24 +520,20 @@ class DeepSpeedEngine:
                 out_shardings=self._shardings.accum)
             accum = accum_jit(params)
 
-        # scaler lives in device state (the micro fn reads loss_scale in
-        # jit); the update decision runs host-side at step time
+        # scaler value lives in device state (the micro fn reads loss_scale
+        # in jit); the update POLICY runs host-side via the shared
+        # DynamicLossScaler — one implementation of hysteresis, not three
         scaler = None
-        args = self._config.dynamic_loss_scale_args or {}
+        self._host_scaler = None
         if self._use_loss_scaler():
-            if self._config.loss_scale and self._config.loss_scale > 0:
-                scaler = make_loss_scale_state(self._config.loss_scale)
-                self._off_dynamic = False
-            else:
-                scaler = make_loss_scale_state(
-                    args.get("init_scale", self._config.initial_dynamic_scale),
-                    delayed_shift=args.get("delayed_shift", 1))
-                self._off_dynamic = True
-        else:
-            self._off_dynamic = False
-        self._off_scale_window = args.get("scale_window", 1000)
-        self._off_min_scale = args.get("min_scale", 1.0)
-        self._off_good_steps = 0
+            from deepspeed_tpu.runtime.fp16.loss_scaler import CreateLossScaler
+
+            args = dict(self._config.dynamic_loss_scale_args or {})
+            args.setdefault("init_scale", self._config.initial_dynamic_scale)
+            self._host_scaler = CreateLossScaler(
+                static_loss_scale=self._config.loss_scale or 0,
+                dynamic_scale_args=args)
+            scaler = make_loss_scale_state(self._host_scaler.cur_scale)
         self._host_skipped = 0
 
         self.state = TrainState(
@@ -777,6 +773,35 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # public training API (reference semantics)
     # ------------------------------------------------------------------
+    def flops_profiler_enabled(self):
+        return self._config.flops_profiler_config.enabled
+
+    def flops_profiler_profile_step(self):
+        return self._config.flops_profiler_config.profile_step
+
+    def _maybe_profile(self, dev_batch):
+        """Print the flops profile at profile_step (reference
+        engine.py:817-847 triggers the profiler the same way)."""
+        cfg = self._config.flops_profiler_config
+        if not cfg.enabled or getattr(self, "_profiled", False):
+            return
+        if self.global_steps + 1 < cfg.profile_step:
+            return
+        self._profiled = True
+        from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+
+        prof = FlopsProfiler(engine=self)
+        prof.profile_params(self.state.params)
+        micro = self._make_micro_fn()
+        import jax
+
+        with jax.set_mesh(self.mesh):
+            prof.profile_fn(micro, self.state, dev_batch, n_timing_runs=3)
+        prof.print_model_profile(profile_step=cfg.profile_step,
+                                 module_depth=cfg.module_depth,
+                                 top_modules=cfg.top_modules,
+                                 detailed=cfg.detailed)
+
     def forward(self, batch):
         """Compute the micro-batch loss (grads are computed alongside and
         committed by backward(), keeping one-fwd-one-bwd cost parity)."""
@@ -785,6 +810,7 @@ class DeepSpeedEngine:
         self._ensure_state(batch)
         self._compile()
         dev_batch = self._shard_batch(batch)
+        self._maybe_profile(dev_batch)
         import jax
 
         with jax.set_mesh(self.mesh):
@@ -852,8 +878,8 @@ class DeepSpeedEngine:
                 accum = jax.jit(lambda a: a, out_shardings=rep_tree)(accum)
         grads_flat = [np.asarray(jax.device_get(g), dtype=np.float32)
                       for g in jax.tree_util.tree_leaves(accum)]
-        scale = float(jax.device_get(state.scaler.loss_scale)) \
-            if state.scaler is not None else 1.0
+        scale = self._host_scaler.cur_scale \
+            if self._host_scaler is not None else 1.0
         finite = all(np.isfinite(g).all() for g in grads_flat)
 
         if finite:
@@ -876,26 +902,14 @@ class DeepSpeedEngine:
                     self._shardings.params)
             self.state = state._replace(params=new_params)
             self._last_grad_norm = gnorm
-            self._off_good_steps += 1
-            self._off_overflows = 0
-            new_scale = scale
-            if self._off_dynamic and \
-                    self._off_good_steps % self._off_scale_window == 0:
-                new_scale = scale * 2.0
         else:
             self._host_skipped += 1
-            self._off_good_steps = 0
             self._last_grad_norm = 0.0
-            new_scale = scale
-            if self._off_dynamic:
-                # hysteresis parity with DynamicLossScaler.delayed_shift:
-                # halve only after `delayed_shift` consecutive overflows
-                self._off_overflows = getattr(self, "_off_overflows", 0) + 1
-                shift = (self._config.dynamic_loss_scale_args or {}).get(
-                    "delayed_shift", 1)
-                if self._off_overflows >= shift:
-                    new_scale = max(self._off_min_scale, scale / 2.0)
-                    self._off_overflows = 0
+        new_scale = scale
+        if self._host_scaler is not None:
+            self._host_scaler.update_scale(not finite)
+            new_scale = self._host_scaler.cur_scale
+        if not finite:
             log_dist(f"ZeRO-Offload: OVERFLOW, skipping step "
                      f"{self.global_steps + 1}, scale -> {new_scale:g}",
                      ranks=[0])
@@ -906,10 +920,7 @@ class DeepSpeedEngine:
             zero_accum = self._jit_zero_accum(self.state.accum)
         scaler = self.state.scaler
         if scaler is not None and new_scale != scale:
-            scaler = make_loss_scale_state(
-                new_scale,
-                delayed_shift=(self._config.dynamic_loss_scale_args or {})
-                .get("delayed_shift", 1))
+            scaler = make_loss_scale_state(new_scale)
         self.state = self.state._replace(
             accum=zero_accum, micro_step=jnp.int32(0),
             step=self.state.step + 1, scaler=scaler)
@@ -969,6 +980,7 @@ class DeepSpeedEngine:
 
         if self._offload:
             # apply runs on host: micro-loop on device, then the CPU step
+            self._maybe_profile(self._shard_batch(_first_micro(batch)))
             self.tput_timer.start()
             losses = []
             with jax.set_mesh(self.mesh):
@@ -982,6 +994,7 @@ class DeepSpeedEngine:
             # mean over micro-batches, matching the fused path's metric
             return jnp.mean(jnp.stack(losses))
         dev = self._shard_stacked_batch(batch)
+        self._maybe_profile(self._shard_batch(_first_micro(batch)))
         lr = self._advance_lr()
 
         self.tput_timer.start()
@@ -1146,6 +1159,9 @@ class DeepSpeedEngine:
             device_skips = int(jax.device_get(self.state.skipped_steps))
             self._host_skipped = max(
                 0, int(meta.get("skipped_steps", 0)) - device_skips)
+            if self._host_scaler is not None and self.state.scaler is not None:
+                self._host_scaler.cur_scale = float(
+                    jax.device_get(self.state.scaler.loss_scale))
 
         self.global_steps = meta["global_steps"]
         self.micro_steps = meta["micro_steps"]

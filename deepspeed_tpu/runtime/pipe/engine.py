@@ -82,22 +82,21 @@ class PipelineEngine(DeepSpeedEngine):
         self.stage_states = None          # list[StageState], lazy
         self._stage_shardings = None
         self._stage_jits = None
-        # host-side dynamic loss scaling (schedule is host-driven)
-        args_ls = self._config.dynamic_loss_scale_args or {}
+        # host-side loss scaling: the schedule is host-driven, so the shared
+        # host DynamicLossScaler owns the policy (hysteresis, window, floor)
         if self.fp16_enabled():
-            if self._config.loss_scale and self._config.loss_scale > 0:
-                self._cur_scale = float(self._config.loss_scale)
-                self._dynamic = False
-            else:
-                self._cur_scale = float(args_ls.get(
-                    "init_scale", self._config.initial_dynamic_scale))
-                self._dynamic = True
+            from deepspeed_tpu.runtime.fp16.loss_scaler import CreateLossScaler
+
+            args_ls = dict(self._config.dynamic_loss_scale_args or {})
+            args_ls.setdefault("init_scale",
+                               self._config.initial_dynamic_scale)
+            self._pipe_scaler = CreateLossScaler(
+                static_loss_scale=self._config.loss_scale or 0,
+                dynamic_scale_args=args_ls)
         else:
-            self._cur_scale = 1.0
-            self._dynamic = False
-        self._scale_window = args_ls.get("scale_window", 1000)
-        self._min_scale = args_ls.get("min_scale", 1.0)
-        self._good_steps = 0
+            from deepspeed_tpu.runtime.fp16.loss_scaler import LossScaler
+
+            self._pipe_scaler = LossScaler(scale=1)
         self._host_skipped = 0
 
         log_dist(
@@ -122,7 +121,7 @@ class PipelineEngine(DeepSpeedEngine):
         return self._host_skipped
 
     def loss_scale(self):
-        return self._cur_scale
+        return self._pipe_scaler.cur_scale
 
     def is_first_stage(self):
         return True   # single controller drives all stages
@@ -363,7 +362,7 @@ class PipelineEngine(DeepSpeedEngine):
             sq_total += float(jax.device_get(sq))
             all_finite &= bool(jax.device_get(finite))
 
-        scale = self._cur_scale
+        scale = self._pipe_scaler.cur_scale
         if all_finite:
             # accum holds sum of scaled per-micro grads (each already /gas)
             inv_scale = 1.0 / scale
@@ -376,18 +375,14 @@ class PipelineEngine(DeepSpeedEngine):
                         self.stage_states[s], np.float32(lr),
                         np.float32(inv_scale), np.float32(clip_factor))
             self._last_grad_norm = gnorm
-            self._good_steps += 1
-            if self._dynamic and self._good_steps % self._scale_window == 0:
-                self._cur_scale *= 2.0
         else:
-            # overflow: drop grads, halve the scale
+            # overflow: drop grads; the shared scaler applies hysteresis
             self._host_skipped += 1
-            self._good_steps = 0
-            if self._dynamic:
-                self._cur_scale = max(self._min_scale, self._cur_scale / 2.0)
+        self._pipe_scaler.update_scale(not all_finite)
+        if not all_finite:
             log_dist(f"PipelineEngine: OVERFLOW, skipping step "
-                     f"{self.global_steps + 1}, scale -> {self._cur_scale:g}",
-                     ranks=[0])
+                     f"{self.global_steps + 1}, scale -> "
+                     f"{self._pipe_scaler.cur_scale:g}", ranks=[0])
             import jax.numpy as jnp
 
             for s in range(self.num_stages):
@@ -520,7 +515,7 @@ class PipelineEngine(DeepSpeedEngine):
                                 gp, gx, loss = jits["bwd_last"](
                                     st.params, in_act[s][buf], rng,
                                     micro_dev[s][buf],
-                                    np.float32(self._cur_scale))
+                                    np.float32(self._pipe_scaler.cur_scale))
                                 losses.append(loss)
                             else:
                                 gp, gx = jits["bwd_mid"](
@@ -600,8 +595,8 @@ class PipelineEngine(DeepSpeedEngine):
             "global_steps": self.global_steps,
             "micro_steps": self.micro_steps,
             "skipped_steps": self._host_skipped,
-            "cur_scale": self._cur_scale,
-            "good_steps": self._good_steps,
+            "cur_scale": self._pipe_scaler.cur_scale,
+            "scaler_state": self._pipe_scaler.__dict__.copy(),
             "num_stages": self.num_stages,
             "partition": self.module.partition_layers(self.num_stages),
             "lr_scheduler": self.lr_scheduler.state_dict()
@@ -652,8 +647,9 @@ class PipelineEngine(DeepSpeedEngine):
         self.global_steps = meta["global_steps"]
         self.micro_steps = meta["micro_steps"]
         self._host_skipped = meta["skipped_steps"]
-        self._cur_scale = meta["cur_scale"]
-        self._good_steps = meta["good_steps"]
+        self._pipe_scaler.cur_scale = meta["cur_scale"]
+        for k, v in meta.get("scaler_state", {}).items():
+            setattr(self._pipe_scaler, k, v)
         if load_lr_scheduler_states and self.lr_scheduler is not None \
                 and meta.get("lr_scheduler") is not None:
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])

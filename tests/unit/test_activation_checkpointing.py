@@ -1,0 +1,147 @@
+"""Activation checkpointing tests — reference
+tests/unit/test_activation_checkpointing.py pattern: grad equality with and
+without checkpointing, for tensor and mixed (tensor + non-tensor) IO."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ckpt
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    ckpt.reset()
+    yield
+    ckpt.reset()
+
+
+def _mlp(params, x):
+    h = jnp.tanh(x @ params["w1"])
+    return jnp.tanh(h @ params["w2"])
+
+
+def _params(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"w1": jnp.asarray(rng.standard_normal((8, 16)), jnp.float32),
+            "w2": jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)}
+
+
+def test_configure_flags():
+    ckpt.configure(partition_activations=True, checkpoint_in_cpu=False,
+                   num_checkpoints=4)
+    assert ckpt.is_configured()
+    ckpt.reset()
+    assert not ckpt.is_configured()
+
+
+def test_configure_from_ds_config():
+    ckpt.configure(deepspeed_config={
+        "train_batch_size": 8,
+        "activation_checkpointing": {"partition_activations": True,
+                                     "cpu_checkpointing": False}})
+    assert ckpt._CONFIG["partition_activations"]
+
+
+def test_contiguous_requires_num_checkpoints():
+    with pytest.raises(ValueError):
+        ckpt.configure(contiguous_checkpointing=True)
+
+
+def test_checkpoint_same_output_and_grads():
+    ckpt.configure()
+    params = _params()
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((4, 8)),
+                    jnp.float32)
+
+    def loss_plain(params):
+        return jnp.sum(_mlp(params, x) ** 2)
+
+    def loss_ckpt(params):
+        return jnp.sum(ckpt.checkpoint(_mlp, params, x) ** 2)
+
+    np.testing.assert_allclose(float(loss_plain(params)),
+                               float(loss_ckpt(params)), rtol=1e-6)
+    g1 = jax.grad(loss_plain)(params)
+    g2 = jax.grad(loss_ckpt)(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g1),
+                    jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_checkpoint_partition_activations_policy():
+    ckpt.configure(partition_activations=True)
+    params = _params()
+    x = jnp.ones((4, 8), jnp.float32)
+    out = ckpt.checkpoint(_mlp, params, x)
+    g = jax.grad(lambda p: jnp.sum(ckpt.checkpoint(_mlp, p, x)))(params)
+    assert np.isfinite(np.asarray(out)).all()
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(g))
+
+
+def test_checkpoint_multiple_tensor_args_and_nontensor_capture():
+    """Mixed IO: extra tensor arg + static python scalar captured in a
+    closure (the reference's non-tensor round trip)."""
+    ckpt.configure()
+    params = _params()
+    x = jnp.ones((4, 8), jnp.float32)
+    y = jnp.ones((4, 8), jnp.float32) * 0.5
+    alpha = 0.3   # static non-tensor
+
+    def fn(params, x, y):
+        return _mlp(params, x) * alpha + y
+
+    out = ckpt.checkpoint(fn, params, x, y)
+    g = jax.grad(lambda p: jnp.sum(ckpt.checkpoint(fn, p, x, y)))(params)
+    exp = fn(params, x, y)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(exp), rtol=1e-6)
+    assert all(np.abs(np.asarray(l)).sum() > 0
+               for l in jax.tree_util.tree_leaves(g))
+
+
+def test_checkpoint_inside_jit():
+    ckpt.configure()
+    params = _params()
+    x = jnp.ones((4, 8), jnp.float32)
+
+    @jax.jit
+    def step(params):
+        return jnp.sum(ckpt.checkpoint(_mlp, params, x))
+
+    assert np.isfinite(float(step(params)))
+
+
+def test_rng_tracker_fork_streams():
+    tracker = ckpt.get_rng_tracker()
+    ckpt.model_parallel_seed(1234, model_parallel_rank=0)
+    k1 = tracker.fork()
+    k2 = tracker.fork()
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+    # distinct ranks -> distinct streams
+    ckpt.model_parallel_seed(1234, model_parallel_rank=1)
+    k3 = tracker.fork()
+    assert not np.array_equal(np.asarray(k1), np.asarray(k3))
+    with pytest.raises(Exception):
+        tracker.add("default", 1)  # duplicate after reseed
+    with pytest.raises(Exception):
+        tracker.fork("missing")
+
+
+def test_model_parallel_rng_differs_per_shard(eight_devices):
+    """Under shard_map over 'model', each shard gets a different key."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(eight_devices[:4]), ("model",))
+
+    def body(x):
+        key = ckpt.model_parallel_rng(jax.random.PRNGKey(0))
+        return x + jax.random.normal(key, x.shape)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("model"),
+                       out_specs=P("model"))
+    out = np.asarray(fn(jnp.zeros((8, 2))))
+    # 4 shards of 2 rows each; shards must differ from each other
+    shards = out.reshape(4, 2, 2)
+    for i in range(1, 4):
+        assert np.abs(shards[i] - shards[0]).max() > 1e-6

@@ -1,0 +1,79 @@
+"""Flops profiler tests — reference tests/unit/test_flops_profiler.py
+pattern: profiled flops within tolerance of the analytic count."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.profiling.flops_profiler import (FlopsProfiler,
+                                                    analyze_jit,
+                                                    flops_to_string,
+                                                    get_model_profile,
+                                                    params_to_string)
+
+
+def test_analyze_matmul_flops():
+    n = 256
+
+    def fn(a, b):
+        return a @ b
+
+    a = jnp.ones((n, n), jnp.float32)
+    cost = analyze_jit(fn, a, a)
+    # matmul = 2*n^3 flops; XLA reports the optimized HLO cost
+    expected = 2 * n ** 3
+    assert cost.get("flops", 0) >= 0.5 * expected
+    assert cost.get("flops", 0) <= 2.0 * expected
+
+
+def test_profiler_end_to_end():
+    def model(params, x):
+        h = jnp.tanh(x @ params["w1"])
+        return jnp.sum((h @ params["w2"]) ** 2)
+
+    params = {"w1": jnp.ones((64, 128)), "w2": jnp.ones((128, 32))}
+    x = jnp.ones((16, 64))
+    prof = FlopsProfiler()
+    prof.profile_params(params)
+    cost = prof.profile_fn(model, params, x)
+    assert prof.get_total_params() == 64 * 128 + 128 * 32
+    assert prof.get_total_flops() > 0
+    assert prof.get_total_duration() > 0
+    text = prof.print_model_profile()
+    assert "FLOPS" in text and "Params" in text
+    # string variants
+    assert "K" in params_to_string(12_300)
+    assert "GFLOPS" in flops_to_string(3.2e9)
+
+
+def test_get_model_profile_oneshot():
+    def fn(x):
+        return jnp.sum(x @ x)
+
+    flops, _, duration = get_model_profile(fn, (jnp.ones((32, 32)),),
+                                           print_profile=False)
+    assert "FLOPS" in flops
+
+
+def test_engine_profile_step_fires():
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel
+
+    model = SimpleModel(hidden_dim=16)
+    cfg = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+           "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+           "flops_profiler": {"enabled": True, "profile_step": 1},
+           "steps_per_print": 100}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                               config_params=cfg)
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.standard_normal((8, 16)).astype(np.float32),
+             "y": rng.integers(0, 4, (8,)).astype(np.int32)}
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    assert engine._profiled
+    # second step must not re-profile
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    assert engine._profiled

@@ -23,6 +23,7 @@ REFERENCE_TFLOPS_PER_CHIP = 64.0
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="gpt2-350m")
+    p.add_argument("--scan_layers", type=int, default=1)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--steps", type=int, default=20)
@@ -37,7 +38,7 @@ def main():
 
     n_dev = len(jax.devices())
     cfg = gpt2_config(args.model, n_positions=args.seq, dtype=jnp.bfloat16,
-                      remat=True)
+                      remat=True, scan_layers=bool(args.scan_layers))
     model = GPT2Model(cfg)
 
     ds_config = {

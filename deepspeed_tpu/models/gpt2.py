@@ -35,6 +35,8 @@ class GPT2Config:
     layer_norm_epsilon: float = 1e-5
     dtype: Any = jnp.bfloat16      # compute dtype
     remat: bool = False            # activation checkpointing per block
+    scan_layers: bool = False      # lax.scan over blocks: compile time O(1)
+                                   # in depth, params stacked (L, ...)
     use_pallas_attention: Optional[bool] = None  # None = auto
 
     @property
@@ -136,8 +138,24 @@ class GPT2LMHead(nn.Module):
         block = Block
         if cfg.remat:
             block = nn.remat(Block, static_argnums=(2,))
-        for i in range(cfg.n_layer):
-            x = block(cfg, name=f"h_{i}")(x, train)
+        if cfg.scan_layers:
+            # ONE traced block scanned over stacked (L, ...) params: the
+            # compiled program is depth-independent (big HLOs from unrolled
+            # deep stacks are the main TPU compile-time cost)
+            class _Body(nn.Module):
+                config: GPT2Config
+
+                @nn.compact
+                def __call__(self, carry, _):
+                    return block(self.config, name="block")(carry, train), None
+
+            stack = nn.scan(_Body, variable_axes={"params": 0},
+                            split_rngs={"params": True, "dropout": True},
+                            length=cfg.n_layer)
+            x, _ = stack(cfg, name="h")(x, None)
+        else:
+            for i in range(cfg.n_layer):
+                x = block(cfg, name=f"h_{i}")(x, train)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                          name="ln_f")(x)
         # tied LM head: logits against the embedding matrix
@@ -170,20 +188,28 @@ class GPT2Model:
         - token embedding: shard vocab dim,
         - LayerNorms/biases on sharded-output layers: shard to match.
         """
+        scanned = self.config.scan_layers
+
         def spec(path, leaf):
             names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
             joined = "/".join(str(n) for n in names)
             if leaf.ndim == 0:
                 return P()
+            # scan-stacked block params carry a leading (L,) dim
+            stacked = scanned and joined.startswith("h/")
+            lead = (None,) if stacked else ()
             if "wte" in joined:
                 return P("model", None)
             if "wpe" in joined:
                 return P()
+            kernel_ndim = leaf.ndim - (1 if stacked else 0)
             if "c_attn" in joined or "c_fc" in joined:
-                return P(None, "model") if leaf.ndim == 2 else P("model")
+                return P(*lead, None, "model") if kernel_ndim == 2 \
+                    else P(*lead, "model")
             if "c_proj" in joined:
-                return P("model", None) if leaf.ndim == 2 else P()
-            return P()
+                return P(*lead, "model", None) if kernel_ndim == 2 \
+                    else P(*lead)
+            return P(*lead) if stacked else P()
 
         return jax.tree_util.tree_map_with_path(spec, params)
 

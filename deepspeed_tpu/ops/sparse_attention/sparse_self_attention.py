@@ -39,16 +39,34 @@ def block_sparse_attention(q, k, v, layout, block: int,
                            rpe=None, key_padding_mask=None, attn_mask=None,
                            key_padding_mask_mode: str = "add",
                            attn_mask_mode: str = "mul",
-                           scale: Optional[float] = None):
+                           scale: Optional[float] = None,
+                           use_pallas: Optional[bool] = None):
     """Masked block-sparse attention.
 
     q/k/v: (B, H, S, D); layout: (H, S/block, S/block) 0/1;
     rpe: (S, S) or broadcastable additive bias;
     key_padding_mask: (B, S) — 'add': float additions (-inf for pad),
         'mul': 0/1 multiplier; attn_mask: (S, S) likewise.
+
+    On TPU with no rpe/masks, dispatches to the LUT-driven Pallas kernel
+    (block_sparse_kernel.py) — O(active blocks) compute/memory; otherwise
+    the XLA masked path runs (O(S^2) compute, still fused).
     """
     import jax
     import jax.numpy as jnp
+
+    if use_pallas is None:
+        use_pallas = (rpe is None and key_padding_mask is None
+                      and attn_mask is None
+                      and jax.default_backend() == "tpu"
+                      and q.shape[2] % block == 0)
+    if use_pallas:
+        from deepspeed_tpu.ops.sparse_attention.block_sparse_kernel import \
+            pallas_block_sparse_attention
+
+        assert rpe is None and key_padding_mask is None and attn_mask is None
+        return pallas_block_sparse_attention(q, k, v, layout, block,
+                                             scale=scale)
 
     B, H, S, D = q.shape
     nb = S // block

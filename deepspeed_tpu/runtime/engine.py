@@ -630,7 +630,10 @@ class DeepSpeedEngine:
 
         def put(x):
             x = np.asarray(x)
-            if x.ndim >= 1 and x.shape[0] % max(1, dp // jax.process_count()) != 0:
+            if x.ndim == 0:
+                # scalars (e.g. pld_theta) replicate
+                return jax.device_put(x, NamedSharding(mesh, P()))
+            if x.shape[0] % max(1, dp // jax.process_count()) != 0:
                 raise ValueError(
                     f"Batch dim0={x.shape[0]} is not divisible by the local "
                     f"data-parallel degree; feed "
@@ -807,6 +810,12 @@ class DeepSpeedEngine:
         committed by backward(), keeping one-fwd-one-bwd cost parity)."""
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).start()
+        if self.progressive_layer_drop is not None:
+            # theta rides the batch as a traced scalar (reference injects it
+            # as module kwargs, engine.py:823-824)
+            batch = dict(batch)
+            batch["pld_theta"] = np.float32(
+                self.progressive_layer_drop.get_theta())
         self._ensure_state(batch)
         self._compile()
         dev_batch = self._shard_batch(batch)
@@ -925,6 +934,8 @@ class DeepSpeedEngine:
             accum=zero_accum, micro_step=jnp.int32(0),
             step=self.state.step + 1, scaler=scaler)
         self.global_steps += 1
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
         self._last_metrics = {"overflow": not finite,
                               "grad_norm": getattr(self, "_last_grad_norm", 0.0),
                               "loss_scale": scale}
@@ -942,6 +953,8 @@ class DeepSpeedEngine:
             new_state, metrics = self._jit_apply(self.state, jnp.float32(lr))
         self.state = new_state
         self.global_steps += 1
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
         self._last_metrics = metrics
         self._last_grad_norm = metrics["grad_norm"]
         if self.fp16_enabled():
@@ -973,6 +986,10 @@ class DeepSpeedEngine:
             assert data_iter is not None
             micros = [next(data_iter) for _ in range(gas)]
             batch = _stack_batches(micros)
+        if self.progressive_layer_drop is not None:
+            batch = dict(batch)
+            batch["pld_theta"] = np.full(
+                (gas,), self.progressive_layer_drop.get_theta(), np.float32)
         self._ensure_state(_first_micro(batch))
         self._compile()
         import jax
@@ -1002,6 +1019,8 @@ class DeepSpeedEngine:
             new_state, metrics = self._jit_fused(self.state, dev, jnp.float32(lr))
         self.state = new_state
         self.global_steps += 1
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
         self.micro_steps += gas
         self._last_metrics = metrics
         self._last_grad_norm = metrics["grad_norm"]
@@ -1059,7 +1078,11 @@ class DeepSpeedEngine:
     # checkpointing (reference engine.py:1279-1597; layout kept similar)
     # ------------------------------------------------------------------
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
-                        save_latest=True):
+                        save_latest=True, backend=None):
+        """backend: None/'auto' (orbax when multi-process — sharded write
+        without gathering, the fix for replicate-on-save OOM), 'npz'
+        (single-file), or 'orbax' (sharded; supports world-size-elastic
+        restore via orbax's sharding-aware load)."""
         import jax
 
         assert self.state is not None, "nothing to save; train state not built"
@@ -1068,26 +1091,30 @@ class DeepSpeedEngine:
             tag = f"global_step{self.global_steps}"
         path = os.path.join(save_dir, str(tag))
         os.makedirs(path, exist_ok=True)
+        if backend in (None, "auto"):
+            backend = "orbax" if jax.process_count() > 1 else "npz"
 
-        state = self.state
-        if jax.process_count() > 1:
-            # cross-host shards are not addressable from process 0; ALL
-            # processes reshard to replicated (collective) before the write
-            from jax.sharding import NamedSharding, PartitionSpec as P
+        if backend == "orbax":
+            import orbax.checkpoint as ocp
 
-            rep = NamedSharding(self.mesh, P())
-            rep_tree = jax.tree_util.tree_map(lambda _: rep, state)
-            with jax.set_mesh(self.mesh):
-                state = jax.jit(lambda s: s, out_shardings=rep_tree)(state)
-        if jax.process_index() == 0:
+            ckptr = ocp.StandardCheckpointer()
+            ckptr.save(os.path.join(os.path.abspath(path), "orbax_state"),
+                       self.state)
+            ckptr.wait_until_finished()
+        num_leaves = len(jax.tree_util.tree_leaves(self.state))
+        if backend == "npz" and jax.process_index() == 0:
             from deepspeed_tpu.runtime.checkpoint_utils import \
                 leaves_to_npz_dict
 
-            host_state = jax.device_get(state)
-            flat, treedef = jax.tree_util.tree_flatten(host_state)
+            host_state = jax.device_get(self.state)
+            flat, _ = jax.tree_util.tree_flatten(host_state)
             np.savez(os.path.join(path, "model_states.npz"),
                      **leaves_to_npz_dict(flat))
+        if jax.process_index() == 0:
             if self._offload:
+                from deepspeed_tpu.runtime.checkpoint_utils import \
+                    leaves_to_npz_dict
+
                 np.savez(os.path.join(path, "offload_states.npz"),
                          **leaves_to_npz_dict(
                              self._host_master_flat + self._host_opt["m"]
@@ -1098,17 +1125,18 @@ class DeepSpeedEngine:
                 "micro_steps": self.micro_steps,
                 "skipped_steps": self.skipped_steps,
                 "dp_world_size": self.dp_world_size,
+                "backend": backend,
                 "lr_scheduler": self.lr_scheduler.state_dict()
                 if self.lr_scheduler is not None else None,
                 "client_state": client_state,
-                "num_leaves": len(flat),
+                "num_leaves": num_leaves,
             }
             with open(os.path.join(path, "metadata.pkl"), "wb") as f:
                 pickle.dump(meta, f)
             if save_latest:
                 with open(os.path.join(save_dir, "latest"), "w") as f:
                     f.write(str(tag))
-        log_dist(f"Saved checkpoint {path}", ranks=[0])
+        log_dist(f"Saved checkpoint {path} (backend={backend})", ranks=[0])
         return True
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
@@ -1125,22 +1153,39 @@ class DeepSpeedEngine:
         path = os.path.join(load_dir, str(tag))
         with open(os.path.join(path, "metadata.pkl"), "rb") as f:
             meta = pickle.load(f)
-        from deepspeed_tpu.runtime.checkpoint_utils import npz_dict_to_leaves
-
-        data = np.load(os.path.join(path, "model_states.npz"))
-        flat = npz_dict_to_leaves(data)
-        assert len(flat) == meta["num_leaves"]
-
         assert self.state is not None, \
             "call forward/train_batch once (or init_from_batch) before load_checkpoint"
         treedef = jax.tree_util.tree_structure(self.state)
-        host_state = jax.tree_util.tree_unflatten(treedef, flat)
-        # re-shard onto the current mesh: elastic by construction — the full
-        # arrays repartition to any world size (reference stage1.py:1197-1255)
-        sh_flat = jax.tree_util.tree_leaves(self._shardings)
-        dev_flat = [jax.device_put(l, s) for l, s in
-                    zip(jax.tree_util.tree_leaves(host_state), sh_flat)]
-        self.state = jax.tree_util.tree_unflatten(treedef, dev_flat)
+        if meta.get("backend") == "orbax":
+            import orbax.checkpoint as ocp
+
+            # sharding-aware restore: orbax repartitions to the CURRENT
+            # shardings, so world-size changes (elastic) need no gather
+            sh_tree = jax.tree_util.tree_unflatten(
+                treedef, jax.tree_util.tree_leaves(self._shardings))
+            template = jax.tree_util.tree_map(
+                lambda l, s: jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                                  sharding=s),
+                self.state, sh_tree)
+            ckptr = ocp.StandardCheckpointer()
+            self.state = ckptr.restore(
+                os.path.join(os.path.abspath(path), "orbax_state"),
+                target=template)
+        else:
+            from deepspeed_tpu.runtime.checkpoint_utils import \
+                npz_dict_to_leaves
+
+            data = np.load(os.path.join(path, "model_states.npz"))
+            flat = npz_dict_to_leaves(data)
+            assert len(flat) == meta["num_leaves"]
+            host_state = jax.tree_util.tree_unflatten(treedef, flat)
+            # re-shard onto the current mesh: elastic by construction — the
+            # full arrays repartition to any world size (reference
+            # stage1.py:1197-1255)
+            sh_flat = jax.tree_util.tree_leaves(self._shardings)
+            dev_flat = [jax.device_put(l, s) for l, s in
+                        zip(jax.tree_util.tree_leaves(host_state), sh_flat)]
+            self.state = jax.tree_util.tree_unflatten(treedef, dev_flat)
 
         if self._offload:
             off = np.load(os.path.join(path, "offload_states.npz"))

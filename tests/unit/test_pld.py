@@ -1,0 +1,74 @@
+"""Progressive Layer Drop tests — reference tests/unit/test_pld.py pattern:
+theta schedule values and engine wiring."""
+import math
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+
+@pytest.mark.parametrize("theta,gamma", [(0.5, 0.001), (0.1, 0.01),
+                                         (1.0, 0.001)])
+def test_theta_schedule(theta, gamma):
+    pld = ProgressiveLayerDrop(theta=theta, gamma=gamma)
+    assert pld.get_theta() == 1.0
+    for step in [0, 10, 100, 1000]:
+        pld.update_state(step)
+        expected = (1.0 - theta) * math.exp(-gamma * step) + theta
+        assert abs(pld.get_theta() - expected) < 1e-12
+    # monotone decay toward theta
+    pld.update_state(10 ** 9)
+    assert abs(pld.get_theta() - theta) < 1e-6
+
+
+def test_get_state():
+    pld = ProgressiveLayerDrop(theta=0.6)
+    state = pld.get_state()
+    assert state["progressive_layer_drop"] is True
+    assert state["pld_theta"] == pld.get_theta()
+
+
+class PLDModel:
+    """Model that consumes batch['pld_theta'] (engine injects it)."""
+
+    def __init__(self):
+        self.seen_thetas = []
+
+    def init(self, rng, batch):
+        import jax.numpy as jnp
+
+        assert "pld_theta" in batch, "engine must inject pld_theta"
+        return {"w": jnp.zeros((4, 4))}
+
+    def loss(self, params, batch, rng, train=True):
+        import jax.numpy as jnp
+
+        theta = batch["pld_theta"]
+        out = batch["x"] @ params["w"] * theta
+        loss = jnp.mean((out - batch["x"]) ** 2)
+        return loss, {"loss": loss}
+
+
+def test_engine_injects_and_advances_theta():
+    model = PLDModel()
+    cfg = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+           "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+           "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                      "gamma": 0.01},
+           "steps_per_print": 100}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                               config_params=cfg)
+    assert engine.progressive_layer_drop is not None
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.standard_normal((8, 4)).astype(np.float32)}
+    thetas = []
+    for _ in range(3):
+        thetas.append(engine.progressive_layer_drop.get_theta())
+        loss = engine(batch)
+        engine.backward(loss)
+        engine.step()
+    assert thetas[0] == 1.0
+    assert thetas[1] < thetas[0] and thetas[2] < thetas[1]

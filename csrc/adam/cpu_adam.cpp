@@ -127,6 +127,15 @@ void ds_fp32_to_bf16(const float* src, std::uint16_t* dst, std::int64_t n) {
     for (std::int64_t i = 0; i < n; ++i) {
         std::uint32_t bits;
         __builtin_memcpy(&bits, src + i, 4);
+        if ((bits & 0x7f800000u) == 0x7f800000u) {
+            // inf/NaN: rounding would carry into the exponent/sign
+            // (0x7FFFFFFF would become -0.0); pass through truncated,
+            // forcing a quiet-NaN mantissa bit for NaN payloads
+            std::uint16_t h = (std::uint16_t)(bits >> 16);
+            if (bits & 0x007fffffu) h |= 0x0040u;  // keep NaN a NaN
+            dst[i] = h;
+            continue;
+        }
         std::uint32_t lsb = (bits >> 16) & 1u;
         bits += 0x7fffu + lsb;   // round to nearest even
         dst[i] = (std::uint16_t)(bits >> 16);

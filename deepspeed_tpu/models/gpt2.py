@@ -163,6 +163,35 @@ class GPT2LMHead(nn.Module):
         return logits
 
 
+def gpt2_tp_leaf_spec(joined: str, leaf, stacked: bool = False):
+    """Megatron-style TP rule for one GPT-2 param leaf — the single source
+    of truth shared by GPT2Model.param_partition_spec and the pipeline
+    LayerSpecs (models/gpt2_pipe.py):
+    - QKV (c_attn) and MLP-in (c_fc) kernels: shard output dim,
+    - attn-out / MLP-out (c_proj) kernels: shard input dim,
+    - token embedding (wte): shard vocab dim,
+    - everything else replicated.
+
+    joined: '/'-joined param path; stacked: leaf carries a leading (L,)
+    scan dim.
+    """
+    if leaf.ndim == 0:
+        return P()
+    lead = (None,) if stacked else ()
+    if "wte" in joined:
+        return P("model", None)
+    if "wpe" in joined:
+        return P()
+    kernel_ndim = leaf.ndim - (1 if stacked else 0)
+    if "c_attn" in joined or "c_fc" in joined:
+        return P(*lead, None, "model") if kernel_ndim == 2 \
+            else P(*lead, "model")
+    if "c_proj" in joined:
+        return P(*lead, "model", None) if kernel_ndim == 2 \
+            else P(*lead)
+    return P(*lead) if stacked else P()
+
+
 class GPT2Model:
     """Engine model contract for GPT-2 (see models/api.py)."""
 
@@ -193,23 +222,9 @@ class GPT2Model:
         def spec(path, leaf):
             names = [getattr(p, "key", getattr(p, "name", str(p))) for p in path]
             joined = "/".join(str(n) for n in names)
-            if leaf.ndim == 0:
-                return P()
             # scan-stacked block params carry a leading (L,) dim
             stacked = scanned and joined.startswith("h/")
-            lead = (None,) if stacked else ()
-            if "wte" in joined:
-                return P("model", None)
-            if "wpe" in joined:
-                return P()
-            kernel_ndim = leaf.ndim - (1 if stacked else 0)
-            if "c_attn" in joined or "c_fc" in joined:
-                return P(*lead, None, "model") if kernel_ndim == 2 \
-                    else P(*lead, "model")
-            if "c_proj" in joined:
-                return P(*lead, "model", None) if kernel_ndim == 2 \
-                    else P(*lead)
-            return P(*lead) if stacked else P()
+            return gpt2_tp_leaf_spec(joined, leaf, stacked)
 
         return jax.tree_util.tree_map_with_path(spec, params)
 

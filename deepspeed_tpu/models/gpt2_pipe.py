@@ -57,15 +57,32 @@ def _tied_lm_head(module, params, x):
     return jnp.einsum("bse,ve->bsv", x, wte.astype(x.dtype))
 
 
+def _tp_spec(params):
+    """Per-layer TP layout: defer every leaf to the shared Megatron rule
+    (models/gpt2.py:gpt2_tp_leaf_spec — single source of truth for both
+    the monolithic and pipeline GPT-2)."""
+    from deepspeed_tpu.models.gpt2 import gpt2_tp_leaf_spec
+
+    def spec(path, leaf):
+        joined = "/".join(str(getattr(p, "key", p)) for p in path)
+        return gpt2_tp_leaf_spec(joined, leaf)
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
 def gpt2_pipeline_module(config: GPT2Config, partition_method="parameters",
                          activation_checkpoint_interval=0):
-    """Build the LayerSpec pipeline for a GPT-2 config."""
-    specs = [TiedLayerSpec("embed", GPT2Embed, config)]
+    """Build the LayerSpec pipeline for a GPT-2 config (TP specs included —
+    with mesh model>1 this is the 3D PP x TP x DP configuration)."""
+    specs = [TiedLayerSpec("embed", GPT2Embed, config,
+                           partition_spec=_tp_spec)]
     for _ in range(config.n_layer):
-        specs.append(LayerSpec(GPT2BlockLayer, config))
+        specs.append(LayerSpec(GPT2BlockLayer, config,
+                               partition_spec=_tp_spec))
     specs.append(LayerSpec(GPT2FinalNorm, config))
     specs.append(TiedLayerSpec("embed", GPT2Embed, config,
-                               forward_fn=_tied_lm_head))
+                               forward_fn=_tied_lm_head,
+                               partition_spec=_tp_spec))
 
     def loss_fn(logits, batch):
         return cross_entropy_loss(logits[:, :-1], batch["labels"][:, 1:],

@@ -20,8 +20,16 @@ MODEL_AXIS = "model"
 AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, MODEL_AXIS)
 
 
-def resolve_mesh_shape(mesh_shape: dict, n_devices: int):
-    """Fill in -1 axes; validate product == n_devices."""
+def resolve_mesh_shape(mesh_shape: dict, n_devices: int,
+                       allow_partial: bool = False):
+    """Fill in -1 axes; validate product == n_devices.
+
+    A fully-specified mesh that uses only a subset of the devices is an
+    error unless ``allow_partial`` — a config typo (stale axis sizes after
+    scaling down) must fail at validation, not silently train on fewer
+    chips. Tests/partial-pod runs opt in via ``mesh["allow_partial"]`` or
+    an explicit devices list to build_mesh.
+    """
     shape = {PIPE_AXIS: mesh_shape.get(PIPE_AXIS, 1),
              DATA_AXIS: mesh_shape.get(DATA_AXIS, -1),
              MODEL_AXIS: mesh_shape.get(MODEL_AXIS, 1)}
@@ -36,9 +44,14 @@ def resolve_mesh_shape(mesh_shape: dict, n_devices: int):
             f"{n_devices} devices not divisible by fixed axes product {fixed}"
         shape[free_axes[0]] = n_devices // fixed
     total = shape[PIPE_AXIS] * shape[DATA_AXIS] * shape[MODEL_AXIS]
-    # a fully-specified mesh may use a subset of devices (tests, partial pods)
-    assert total <= n_devices, \
-        f"mesh {shape} needs {total} devices but {n_devices} available"
+    if allow_partial:
+        assert total <= n_devices, \
+            f"mesh {shape} needs {total} devices but {n_devices} available"
+    else:
+        assert total == n_devices, (
+            f"mesh {shape} covers {total} of {n_devices} devices; set "
+            f'mesh["allow_partial"] = true (or pass an explicit devices '
+            f"list) to intentionally train on a subset")
     return shape
 
 
@@ -46,13 +59,20 @@ def build_mesh(mesh_shape: Optional[dict] = None, devices=None):
     """Build a Mesh with axes ('pipe','data','model').
 
     mesh_shape: {"pipe": P, "data": D, "model": M}; -1 = fill remaining.
+    An explicit devices list always permits a subset mesh (the caller
+    already chose the devices); otherwise subset meshes require
+    mesh_shape["allow_partial"].
     """
     import jax
     from jax.sharding import Mesh
 
+    mesh_shape = dict(mesh_shape or {})
+    allow_partial = bool(mesh_shape.pop("allow_partial", False))
     if devices is None:
         devices = jax.devices()
-    shape = resolve_mesh_shape(mesh_shape or {}, len(devices))
+    else:
+        allow_partial = True
+    shape = resolve_mesh_shape(mesh_shape, len(devices), allow_partial)
     total = shape[PIPE_AXIS] * shape[DATA_AXIS] * shape[MODEL_AXIS]
     if total < len(devices):
         from deepspeed_tpu.utils.logging import logger

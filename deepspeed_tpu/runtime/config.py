@@ -121,6 +121,22 @@ def get_gradient_clipping(param_dict):
     return get_scalar_param(param_dict, GRADIENT_CLIPPING, GRADIENT_CLIPPING_DEFAULT)
 
 
+def get_checkpoint_params(param_dict):
+    return param_dict.get(CHECKPOINT, {})
+
+
+def get_checkpoint_tag_validation_mode(checkpoint_params):
+    """Reference config.py:483-491: 'ignore' | 'warn' | 'fail'."""
+    mode = checkpoint_params.get(CHECKPOINT_TAG_VALIDATION,
+                                 CHECKPOINT_TAG_VALIDATION_DEFAULT)
+    mode = mode.upper()
+    if mode in CHECKPOINT_TAG_VALIDATION_MODES:
+        return mode
+    raise ValueError(
+        f"Checkpoint config contains invalid tag_validation value "
+        f"{mode!r}, expecting one of {CHECKPOINT_TAG_VALIDATION_MODES}")
+
+
 def get_sparse_attention(param_dict):
     if SPARSE_ATTENTION in param_dict:
         sparsity = param_dict[SPARSE_ATTENTION]
@@ -247,11 +263,14 @@ def get_mesh_shape(param_dict):
     -1 for the data axis means "whatever is left over" after model/pipe.
     """
     d = param_dict.get(MESH, {})
-    return {
+    shape = {
         MESH_PIPE_AXIS: d.get(MESH_PIPE_AXIS, 1),
         MESH_DATA_AXIS: d.get(MESH_DATA_AXIS, -1),
         MESH_MODEL_AXIS: d.get(MESH_MODEL_AXIS, 1),
     }
+    if d.get(MESH_ALLOW_PARTIAL, False):
+        shape[MESH_ALLOW_PARTIAL] = True
+    return shape
 
 
 def get_pipeline_config(param_dict):
@@ -366,6 +385,8 @@ class DeepSpeedConfig:
 
         self.gradient_clipping = get_gradient_clipping(param_dict)
         self.sparse_attention = get_sparse_attention(param_dict)
+        self.checkpoint_tag_validation_mode = \
+            get_checkpoint_tag_validation_mode(get_checkpoint_params(param_dict))
 
         self.pld_enabled, self.pld_theta, self.pld_gamma = \
             get_progressive_layer_drop(param_dict)

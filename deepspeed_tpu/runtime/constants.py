@@ -163,6 +163,15 @@ MESH = "mesh"                       # {"data": -1, "model": 1, "pipe": 1}
 MESH_DATA_AXIS = "data"
 MESH_MODEL_AXIS = "model"
 MESH_PIPE_AXIS = "pipe"
+MESH_ALLOW_PARTIAL = "allow_partial"   # opt-in: mesh may cover a device subset
+
+#############################################
+# Checkpoint (reference constants: "checkpoint": {"tag_validation": "Warn"})
+#############################################
+CHECKPOINT = "checkpoint"
+CHECKPOINT_TAG_VALIDATION = "tag_validation"
+CHECKPOINT_TAG_VALIDATION_DEFAULT = "Warn"
+CHECKPOINT_TAG_VALIDATION_MODES = ["WARN", "IGNORE", "FAIL"]
 
 PIPELINE = "pipeline"               # pipeline engine knobs
 PIPELINE_STAGES = "stages"

@@ -128,6 +128,14 @@ class DeepSpeedEngine:
 
         # --- optimizer / scheduler / misc --------------------------------
         self.optimizer = self._configure_basic_optimizer()
+        if self.zero_optimization_stage() > 0:
+            # reference engine.py:694-700 gates client optimizers through
+            # the ZeRO whitelist before partitioning their state
+            from deepspeed_tpu.runtime.zero.utils import \
+                assert_zero_supported_optimizer
+
+            assert_zero_supported_optimizer(
+                self.optimizer, self._config.zero_allow_untested_optimizer)
         self.lr_scheduler = self._configure_lr_scheduler()
         self.progressive_layer_drop = None
         if self.pld_enabled():
@@ -150,13 +158,18 @@ class DeepSpeedEngine:
         self._jit_fused = None
         self._jit_eval = None
         self._pending_state = None
+        self._train_mode = True
         self._pending_loss = None
-        self._monitor_file = None
+        self.summary_writer = None
         if self.tensorboard_enabled() and jax.process_index() == 0:
-            os.makedirs(self.tensorboard_output_path() or ".", exist_ok=True)
-            self._monitor_file = os.path.join(
-                self.tensorboard_output_path() or ".",
-                f"{self.tensorboard_job_name()}.events.jsonl")
+            from deepspeed_tpu.utils.tb_writer import SummaryWriter
+
+            # real TensorBoard event-file format (reference tensorboardX,
+            # engine.py:157-158) — native writer, no tensorboard dep
+            self.summary_writer = SummaryWriter(
+                log_dir=os.path.join(
+                    self.tensorboard_output_path() or ".",
+                    self.tensorboard_job_name() or "DeepSpeedJobName"))
 
         seed = int(raw_dict.get("seed", 42))
         self._init_rng = jax.random.PRNGKey(seed)
@@ -805,9 +818,22 @@ class DeepSpeedEngine:
                                  top_modules=cfg.top_modules,
                                  detailed=cfg.detailed)
 
+    def train(self, mode=True):
+        """torch-parity module mode (reference engine is an nn.Module):
+        in eval mode forward() computes the loss WITHOUT gradients —
+        inference pays forward cost only, not backward+accum."""
+        self._train_mode = bool(mode)
+        return self
+
+    def eval(self):
+        return self.train(False)
+
     def forward(self, batch):
         """Compute the micro-batch loss (grads are computed alongside and
-        committed by backward(), keeping one-fwd-one-bwd cost parity)."""
+        committed by backward(), keeping one-fwd-one-bwd cost parity).
+        In eval mode (engine.eval()) this is a grad-free forward."""
+        if not self._train_mode:
+            return self.eval_loss(batch)
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).start()
         if self.progressive_layer_drop is not None:
@@ -1067,12 +1093,37 @@ class DeepSpeedEngine:
                  f"scale={scale:g}", ranks=[0])
 
     def _write_monitor(self, scalars: dict):
-        if self._monitor_file is None:
+        if self.summary_writer is None:
             return
-        import json
+        for tag, v in scalars.items():
+            self.summary_writer.add_scalar(f"Train/Samples/{tag}", float(v),
+                                           self.global_steps)
+        self.summary_writer.flush()
 
-        with open(self._monitor_file, "a") as f:
-            f.write(json.dumps({"step": self.global_steps, **scalars}) + "\n")
+    def _checkpoint_tag_validation(self, tag):
+        """Cross-process consistency check on the checkpoint tag
+        (reference engine.py:1472-1487: min/max allreduce of the tag hash;
+        a rank writing under a different tag corrupts the layout)."""
+        mode = getattr(self._config, "checkpoint_tag_validation_mode", "WARN")
+        import jax
+
+        if mode == "IGNORE" or jax.process_count() == 1:
+            return
+        import hashlib
+
+        from jax.experimental import multihost_utils
+
+        digest = int.from_bytes(
+            hashlib.sha256(str(tag).encode()).digest()[:4], "big")
+        arr = np.asarray([digest], dtype=np.int64)
+        lo = multihost_utils.process_allgather(arr).min()
+        hi = multihost_utils.process_allgather(arr).max()
+        if int(lo) != int(hi):
+            msg = (f"checkpoint tag {tag!r} is not consistent across "
+                   f"processes (hash min {lo} != max {hi})")
+            if mode == "FAIL":
+                raise AssertionError(msg)
+            logger.warning(msg)
 
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:1279-1597; layout kept similar)
@@ -1089,10 +1140,20 @@ class DeepSpeedEngine:
         client_state = client_state or {}
         if tag is None:
             tag = f"global_step{self.global_steps}"
+        self._checkpoint_tag_validation(tag)
         path = os.path.join(save_dir, str(tag))
         os.makedirs(path, exist_ok=True)
         if backend in (None, "auto"):
-            backend = "orbax" if jax.process_count() > 1 else "npz"
+            # orbax by default: sharded write with NO host gather — npz
+            # would materialize the full TrainState on process 0 (a 10B
+            # state OOMs the host); npz stays available for tiny/portable
+            # checkpoints
+            try:
+                import orbax.checkpoint  # noqa: F401
+
+                backend = "orbax"
+            except ImportError:  # pragma: no cover - orbax is baked in
+                backend = "npz"
 
         if backend == "orbax":
             import orbax.checkpoint as ocp
@@ -1143,6 +1204,10 @@ class DeepSpeedEngine:
                         load_optimizer_states=True, load_lr_scheduler_states=True):
         import jax
 
+        # imported here (not in the npz branch) because the offload restore
+        # below needs it regardless of which backend saved the model state
+        from deepspeed_tpu.runtime.checkpoint_utils import npz_dict_to_leaves
+
         if tag is None:
             latest = os.path.join(load_dir, "latest")
             if not os.path.exists(latest):
@@ -1172,9 +1237,6 @@ class DeepSpeedEngine:
                 os.path.join(os.path.abspath(path), "orbax_state"),
                 target=template)
         else:
-            from deepspeed_tpu.runtime.checkpoint_utils import \
-                npz_dict_to_leaves
-
             data = np.load(os.path.join(path, "model_states.npz"))
             flat = npz_dict_to_leaves(data)
             assert len(flat) == meta["num_leaves"]

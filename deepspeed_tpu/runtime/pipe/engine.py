@@ -133,24 +133,56 @@ class PipelineEngine(DeepSpeedEngine):
     # state construction
     # ------------------------------------------------------------------
     def _stage_zero_shardings(self, submesh, params_template):
-        """NamedShardings for one stage: params replicated (TP later),
-        master/opt/accum ZeRO-sharded over the submesh 'data' axis."""
+        """NamedShardings for one stage: params take the layers' TP specs
+        over the submesh 'model' axis (PP x TP — the reference's 3D grid,
+        pipe/topology.py:246-249), master/opt/accum additionally
+        ZeRO-sharded over the submesh 'data' axis."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         stage = self.zero_optimization_stage()
         dp = submesh.shape["data"]
 
-        rep = jax.tree_util.tree_map(
-            lambda _: NamedSharding(submesh, P()), params_template)
+        tp_spec = self.module.param_partition_spec(params_template)
+        is_p = lambda x: isinstance(x, P)  # noqa: E731
+        param_sh = jax.tree_util.tree_map(
+            lambda s: NamedSharding(submesh, s), tp_spec, is_leaf=is_p)
         if stage == 0:
-            zero = rep
+            zero_spec = tp_spec
+            zero = param_sh
         else:
+            zero_spec = jax.tree_util.tree_map(
+                lambda s, l: mesh_lib.zero_merge_spec(s, l, dp),
+                tp_spec, params_template, is_leaf=is_p)
             zero = jax.tree_util.tree_map(
-                lambda l: NamedSharding(
-                    submesh, mesh_lib.zero_merge_spec(P(), l, dp)),
-                params_template)
-        return rep, zero
+                lambda s: NamedSharding(submesh, s), zero_spec, is_leaf=is_p)
+
+        # optimizer-state shardings (same policy as the base engine,
+        # runtime/engine.py:_build_shardings): the optimizer declares its
+        # state layout via state_spec; fallback matches param shapes
+        rep = NamedSharding(submesh, P())
+        opt_template = jax.eval_shape(self.optimizer.init_state,
+                                      params_template)
+        flat_opt, opt_def = jax.tree_util.tree_flatten(opt_template)
+        if hasattr(self.optimizer, "state_spec"):
+            spec_tree = self.optimizer.state_spec(zero_spec)
+            spec_flat = jax.tree_util.tree_flatten(
+                spec_tree, is_leaf=lambda x: x is None or isinstance(x, P))[0]
+            assert len(spec_flat) == len(flat_opt)
+            opt_sh_flat = [rep if s is None else NamedSharding(submesh, s)
+                           for s in spec_flat]
+        else:
+            zero_flat = jax.tree_util.tree_leaves(zero)
+            shapes = [tuple(l.shape) for l in
+                      jax.tree_util.tree_leaves(params_template)]
+            by_shape = {}
+            for shp, sh in zip(shapes, zero_flat):
+                by_shape.setdefault(shp, sh)
+            opt_sh_flat = [rep if leaf.ndim == 0
+                           else by_shape.get(tuple(leaf.shape), rep)
+                           for leaf in flat_opt]
+        opt_sh = opt_def.unflatten(opt_sh_flat)
+        return param_sh, zero, opt_sh
 
     def _ensure_pipe_state(self, sample_micro):
         if self.stage_states is not None:
@@ -183,7 +215,7 @@ class PipelineEngine(DeepSpeedEngine):
             submesh = self._submeshes[s]
             keys = self.module.stage_param_keys(s)
             p32 = {k: full_params[k] for k in keys}
-            rep, zero = self._stage_zero_shardings(submesh, p32)
+            rep, zero, opt_sh = self._stage_zero_shardings(submesh, p32)
 
             master = jax.tree_util.tree_map(
                 lambda l, sh: jax.device_put(l, sh), p32, zero) \
@@ -195,7 +227,10 @@ class PipelineEngine(DeepSpeedEngine):
                 jax.tree_util.tree_map(lambda l, sh: jax.device_put(l, sh),
                                        p32, zero)
             with jax.set_mesh(submesh):
-                opt_state = jax.jit(self.optimizer.init_state)(opt_src)
+                # out_shardings pins the declared layout — unconstrained,
+                # XLA would pick its own and void the ZeRO partitioning
+                opt_state = jax.jit(self.optimizer.init_state,
+                                    out_shardings=opt_sh)(opt_src)
                 accum = jax.tree_util.tree_map(
                     lambda l: jnp.zeros(l.shape, jnp.float32), p32)
                 accum = jax.tree_util.tree_map(
@@ -203,7 +238,7 @@ class PipelineEngine(DeepSpeedEngine):
             self.stage_states.append(StageState(
                 params=params, master=master, opt_state=opt_state,
                 accum=accum))
-            self._stage_shardings.append((rep, zero))
+            self._stage_shardings.append((rep, zero, opt_sh))
         self._build_stage_jits()
         n = sum(self.module.num_params(st.params) for st in self.stage_states)
         log_dist(f"Pipeline state initialized: {n/1e6:.1f}M params over "
@@ -254,9 +289,15 @@ class PipelineEngine(DeepSpeedEngine):
                 gp, gx = vjp(gy)
                 return gp, gx
 
-            def accum_add(accum, gp):
+            rep_sh, zero_sh, opt_sh = self._stage_shardings[s]
+
+            def accum_add(accum, gp, zero_sh=zero_sh):
+                # pin the ZeRO layout: without the constraint XLA is free to
+                # re-lay-out the donated accumulator after the add
                 return jax.tree_util.tree_map(
-                    lambda a, g: a + g.astype(jnp.float32), accum, gp)
+                    lambda a, g, sh: jax.lax.with_sharding_constraint(
+                        a + g.astype(jnp.float32), sh),
+                    accum, gp, zero_sh)
 
             def sqnorm(accum):
                 total = jnp.float32(0.0)
@@ -271,19 +312,33 @@ class PipelineEngine(DeepSpeedEngine):
             mixed = self.mixed_precision
             cdtype = self.compute_dtype
 
-            def apply_step(state: StageState, lr, inv_scale, clip_factor):
+            def apply_step(state: StageState, lr, inv_scale, clip_factor,
+                           rep_sh=rep_sh, zero_sh=zero_sh, opt_sh=opt_sh):
                 grads = jax.tree_util.tree_map(
                     lambda g: g * inv_scale * clip_factor, state.accum)
                 target = state.master if mixed else state.params
                 new_master, new_opt = optimizer.update(
                     grads, state.opt_state, target, lr=lr)
+                new_opt = jax.tree_util.tree_map(
+                    jax.lax.with_sharding_constraint, new_opt, opt_sh)
+                # pin layouts: params keep the TP spec (replicated over
+                # 'data' — the ZeRO all-gather happens here, reference
+                # stage2.py:1556-1590), master stays ZeRO-sharded.
+                # Unconstrained, XLA would leave params data-sharded and
+                # re-gather on every forward.
                 if mixed:
+                    new_master = jax.tree_util.tree_map(
+                        jax.lax.with_sharding_constraint, new_master, zero_sh)
                     new_params = jax.tree_util.tree_map(
-                        lambda l: l.astype(cdtype), new_master)
+                        lambda l, sh: jax.lax.with_sharding_constraint(
+                            l.astype(cdtype), sh), new_master, rep_sh)
                 else:
-                    new_params, new_master = new_master, None
+                    new_params = jax.tree_util.tree_map(
+                        jax.lax.with_sharding_constraint, new_master, rep_sh)
+                    new_master = None
                 zero_accum = jax.tree_util.tree_map(
-                    jnp.zeros_like, state.accum)
+                    lambda l, sh: jax.lax.with_sharding_constraint(
+                        jnp.zeros_like(l), sh), state.accum, zero_sh)
                 return StageState(params=new_params, master=new_master,
                                   opt_state=new_opt, accum=zero_accum)
 

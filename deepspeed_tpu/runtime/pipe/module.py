@@ -20,12 +20,21 @@ from deepspeed_tpu.utils.logging import logger
 
 class LayerSpec:
     """Lazily-built layer: stores the constructor + args so stages only pay
-    for what they build (reference module.py:23-68)."""
+    for what they build (reference module.py:23-68).
 
-    def __init__(self, typename, *module_args, **module_kwargs):
+    partition_spec: optional callable ``params -> pytree of PartitionSpec``
+    declaring this layer's tensor-parallel layout over the mesh 'model'
+    axis. This is what makes PP x TP (true 3D) expressible: the reference
+    threads an external Megatron mpu through its pipeline grid
+    (pipe/topology.py:246-249); here each layer declares its own sharding
+    and the stage submeshes honor it."""
+
+    def __init__(self, typename, *module_args, partition_spec=None,
+                 **module_kwargs):
         self.typename = typename
         self.module_args = module_args
         self.module_kwargs = module_kwargs
+        self.partition_spec = partition_spec
 
     def build(self, log=False):
         if log:
@@ -42,8 +51,10 @@ class TiedLayerSpec(LayerSpec):
     key — e.g. embedding reused as the LM head (reference module.py:71-83)."""
 
     def __init__(self, key, typename, *module_args, forward_fn=None,
-                 tied_weight_attr="embedding", **module_kwargs):
-        super().__init__(typename, *module_args, **module_kwargs)
+                 tied_weight_attr="embedding", partition_spec=None,
+                 **module_kwargs):
+        super().__init__(typename, *module_args,
+                         partition_spec=partition_spec, **module_kwargs)
         self.key = key
         self.forward_fn = forward_fn
         self.tied_weight_attr = tied_weight_attr
@@ -61,13 +72,16 @@ def _is_flax_module(obj):
 class _Layer:
     """Uniform init/apply wrapper over flax modules and plain callables."""
 
-    def __init__(self, obj, index, param_key, forward_fn=None):
+    def __init__(self, obj, index, param_key, forward_fn=None, spec_fn=None):
         import inspect
 
         self.obj = obj
         self.index = index
         self.param_key = param_key        # None => stateless
         self.forward_fn = forward_fn
+        # TP layout provider: LayerSpec.partition_spec wins, else a
+        # param_partition_spec method on the built module itself
+        self.spec_fn = spec_fn or getattr(obj, "param_partition_spec", None)
         self.is_flax = _is_flax_module(obj)
         self.type_name = type(obj).__name__
         self.tied_key = None
@@ -142,13 +156,14 @@ class PipelineModule:
         for i, spec in enumerate(self.specs):
             if isinstance(spec, TiedLayerSpec):
                 layer = _Layer(spec.build(), i, f"tied_{spec.key}",
-                               spec.forward_fn)
+                               spec.forward_fn, spec_fn=spec.partition_spec)
                 layer.tied_key = spec.key
                 if spec.key not in tied_owner:
                     tied_owner[spec.key] = i
                 layer.is_tied_owner = tied_owner[spec.key] == i
             elif isinstance(spec, LayerSpec):
-                layer = _Layer(spec.build(), i, f"layer_{i:02d}")
+                layer = _Layer(spec.build(), i, f"layer_{i:02d}",
+                               spec_fn=spec.partition_spec)
             else:
                 layer = _Layer(spec, i,
                                f"layer_{i:02d}" if _is_flax_module(spec)
@@ -212,7 +227,11 @@ class PipelineModule:
         import jax
 
         for layer in layers:
-            lrng = jax.random.fold_in(rng, layer.index if self.seed_layers else 0)
+            # dropout keys fold in layer.index unconditionally: identical
+            # same-shaped layers must not share dropout masks (seed_layers
+            # only controls the *init* seed, matching reference module.py:85
+            # where torch's global RNG advances per layer regardless)
+            lrng = jax.random.fold_in(rng, layer.index)
             p = params[layer.param_key] if layer.param_key is not None else None
             x = layer.apply(p, x, lrng, train)
         return x
@@ -289,11 +308,32 @@ class PipelineModule:
         return {k: sorted(v) for k, v in groups.items() if len(v) > 1}
 
     def param_partition_spec(self, params):
-        """Per-layer TP specs: replicated by default (layers may be plain)."""
+        """Per-layer TP specs over the mesh 'model' axis.
+
+        Works on any subset of the params dict (a stage's subtree): each
+        top-level key is resolved to its owning layer and that layer's
+        spec_fn (LayerSpec.partition_spec or the module's own
+        param_partition_spec) produces the specs; layers without one are
+        replicated. This is the hook that gives pipeline models real TP —
+        the reference's analog is the mpu slice group carried by
+        PipeModelDataParallelTopology (topology.py:246-249)."""
         import jax
         from jax.sharding import PartitionSpec as P
 
-        return jax.tree_util.tree_map(lambda _: P(), params)
+        by_key = {}
+        for layer in self._layers:
+            if layer.param_key is None or layer.param_key in by_key:
+                continue
+            if layer.spec_fn is not None:
+                by_key[layer.param_key] = layer.spec_fn
+        out = {}
+        for key, subtree in params.items():
+            fn = by_key.get(key)
+            if fn is None:
+                out[key] = jax.tree_util.tree_map(lambda _: P(), subtree)
+            else:
+                out[key] = fn(subtree)
+        return out
 
     def num_params(self, params):
         import jax

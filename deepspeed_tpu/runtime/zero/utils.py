@@ -1,0 +1,53 @@
+"""ZeRO compatibility checks.
+
+Reference behavior: deepspeed/runtime/zero/utils.py:36-58 whitelists the
+optimizers whose state layout ZeRO knows how to partition, and the engine
+refuses unlisted client optimizers unless ``zero_allow_untested_optimizer``
+(reference engine.py:681-700).
+
+TPU-native formulation: ZeRO partitioning here is a sharding-spec contract —
+an optimizer is ZeRO-supported when it declares its state layout via
+``state_spec(param_specs)`` (see ops/adam/fused_adam.py:state_spec). Known
+in-tree optimizers are whitelisted by class as well, mirroring the
+reference's list.
+"""
+from deepspeed_tpu.utils.logging import logger
+
+
+class ZeRORuntimeException(Exception):
+    pass
+
+
+def _supported_classes():
+    from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+    from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+
+    return (FusedAdam, DeepSpeedCPUAdam)
+
+
+def is_zero_supported_optimizer(optimizer) -> bool:
+    """An optimizer qualifies if it is a known in-tree class OR declares a
+    ``state_spec`` layout (the exact-sharding contract the engine uses)."""
+    if isinstance(optimizer, _supported_classes()):
+        return True
+    return hasattr(optimizer, "state_spec")
+
+
+def assert_zero_supported_optimizer(optimizer, allow_untested: bool):
+    """Engine-side gate (reference engine.py:694-700): raise for unlisted
+    client optimizers unless zero_allow_untested_optimizer is set."""
+    if is_zero_supported_optimizer(optimizer):
+        return
+    name = type(optimizer).__name__
+    if allow_untested:
+        logger.warning(
+            f"**** You are using ZeRO with an untested optimizer "
+            f"{name!r} (no state_spec); optimizer-state sharding falls "
+            f"back to shape matching and may be inexact ****")
+        return
+    raise ZeRORuntimeException(
+        f"You are using ZeRO with an optimizer ({name!r}) that is not "
+        f"ZeRO-supported: it neither is a known in-tree optimizer nor "
+        f"declares state_spec(). Implement state_spec() or set "
+        f"'zero_allow_untested_optimizer': true in the config to proceed "
+        f"anyway")

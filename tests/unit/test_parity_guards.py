@@ -1,0 +1,133 @@
+"""Small parity guards added in round 2: ZeRO optimizer whitelist,
+checkpoint tag validation config, grad-free eval forward, TB event files,
+strict mesh validation (reference zero/utils.py:36-58, engine.py:1472-1487,
+config.py:483-491)."""
+import struct
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from tests.unit.simple_model import SimpleModel
+
+
+def _cfg(extra=None, world=8):
+    cfg = {
+        "train_batch_size": 2 * world,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "steps_per_print": 100,
+    }
+    if extra:
+        cfg.update(extra)
+    return cfg
+
+
+class _NoSpecOptimizer:
+    """Client optimizer without state_spec: not ZeRO-supported."""
+    lr = 0.01
+
+    def init_state(self, params):
+        return ()
+
+    def update(self, grads, state, params, lr):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, params, grads), state
+
+
+def test_zero_rejects_untested_client_optimizer():
+    from deepspeed_tpu.runtime.zero.utils import ZeRORuntimeException
+
+    with pytest.raises(ZeRORuntimeException):
+        deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=8),
+            optimizer=_NoSpecOptimizer(),
+            config_params=_cfg({"zero_optimization": {"stage": 2}}))
+
+
+def test_zero_allows_untested_with_optin():
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=8),
+        optimizer=_NoSpecOptimizer(),
+        config_params=_cfg({"zero_optimization": {"stage": 2},
+                            "zero_allow_untested_optimizer": True}))
+    assert engine is not None
+
+
+def test_zero_accepts_inbuilt_adam():
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=8),
+        config_params=_cfg({"zero_optimization": {"stage": 2}}))
+    assert engine is not None
+
+
+def test_tag_validation_mode_parsing():
+    from deepspeed_tpu.runtime.config import (
+        get_checkpoint_tag_validation_mode)
+
+    assert get_checkpoint_tag_validation_mode({}) == "WARN"
+    assert get_checkpoint_tag_validation_mode(
+        {"tag_validation": "fail"}) == "FAIL"
+    with pytest.raises(ValueError):
+        get_checkpoint_tag_validation_mode({"tag_validation": "bogus"})
+
+
+def test_eval_mode_forward_is_grad_free():
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=8), config_params=_cfg())
+    batch = {"x": np.random.randn(16, 8).astype(np.float32),
+             "y": np.random.randint(0, 4, (16,)).astype(np.int32)}
+    engine.train_batch(batch={"x": batch["x"][None], "y": batch["y"][None]})
+    engine.eval()
+    loss = engine.forward(batch)
+    # no staged gradient state: backward() must fail after eval forward
+    assert engine._pending_state is None
+    assert np.isfinite(float(loss))
+    engine.train()
+    loss2 = engine.forward(batch)
+    assert engine._pending_state is not None
+    engine.backward(loss2)
+
+
+def test_tensorboard_writes_real_event_file(tmp_path):
+    from deepspeed_tpu.utils.tb_writer import SummaryWriter, _masked_crc
+
+    w = SummaryWriter(str(tmp_path))
+    w.add_scalar("Train/lr", 0.5, 3)
+    w.close()
+    data = open(w.path, "rb").read()
+    off, recs = 0, []
+    while off < len(data):
+        (ln,) = struct.unpack("<Q", data[off:off + 8])
+        assert struct.unpack("<I", data[off + 8:off + 12])[0] == \
+            _masked_crc(data[off:off + 8])
+        rec = data[off + 12:off + 12 + ln]
+        assert struct.unpack("<I", data[off + 12 + ln:off + 16 + ln])[0] == \
+            _masked_crc(rec)
+        recs.append(rec)
+        off += 16 + ln
+    assert b"brain.Event:2" in recs[0]
+    assert b"Train/lr" in recs[1]
+
+
+def test_engine_tensorboard_config_writes_events(tmp_path):
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=8),
+        config_params=_cfg({"tensorboard": {
+            "enabled": True, "output_path": str(tmp_path),
+            "job_name": "job1"}}))
+    assert engine.summary_writer is not None
+    engine._write_monitor({"lr": 0.1})
+    data = open(engine.summary_writer.path, "rb").read()
+    assert b"Train/Samples/lr" in data
+
+
+def test_strict_mesh_rejects_subset():
+    with pytest.raises(AssertionError, match="allow_partial"):
+        deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=8),
+            config_params=_cfg({"mesh": {"pipe": 1, "data": 2, "model": 1}},
+                               world=2))

@@ -29,8 +29,10 @@ import time
 REFERENCE_TFLOPS_PER_CHIP = 64.0
 
 
-def _peak_tflops(device_kind: str) -> float:
-    """bf16 peak TFLOPS/chip for MFU. Matched by substring on device_kind."""
+def _peak_tflops(device_kind: str):
+    """(bf16 peak TFLOPS/chip, known) for MFU, matched by substring on
+    device_kind. Unknown chips return known=False and the worker publishes
+    mfu=null instead of a number against a guessed peak."""
     kind = (device_kind or "").lower().replace(" ", "")
     table = [
         ("v6e", 918.0), ("v6", 918.0),
@@ -39,13 +41,13 @@ def _peak_tflops(device_kind: str) -> float:
     ]
     for key, peak in table:
         if key in kind:
-            return peak
+            return peak, True
     # the axon tunnel advertises the chip generation via env
     env_kind = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
     for key, peak in table:
         if key in env_kind:
-            return peak
-    return 459.0  # assume v5p-class when unidentifiable
+            return peak, True
+    return 459.0, False  # v5p-class placeholder; flagged unknown
 
 
 # ---------------------------------------------------------------------------
@@ -129,7 +131,7 @@ def run_worker(args) -> int:
     # flops only, same convention as the reference's TFLOPS claims)
     model_tflops = 6.0 * n_params * tokens_per_sec / 1e12
     tflops_per_chip = model_tflops / n_dev
-    peak = _peak_tflops(device_kind)
+    peak, peak_known = _peak_tflops(device_kind)
     vs_baseline = tflops_per_chip / REFERENCE_TFLOPS_PER_CHIP
 
     print(json.dumps({
@@ -138,8 +140,8 @@ def run_worker(args) -> int:
         "value": round(tflops_per_chip, 2),
         "unit": "TFLOPS/chip",
         "vs_baseline": round(vs_baseline, 3),
-        "mfu": round(tflops_per_chip / peak, 4),
-        "peak_tflops_per_chip": peak,
+        "mfu": round(tflops_per_chip / peak, 4) if peak_known else None,
+        "peak_tflops_per_chip": peak if peak_known else None,
         "device_kind": device_kind,
         "platform": platform,
         "samples_per_sec": round(samples_per_sec, 2),

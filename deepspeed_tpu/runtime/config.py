@@ -129,9 +129,8 @@ def get_checkpoint_tag_validation_mode(checkpoint_params):
     """Reference config.py:483-491: 'ignore' | 'warn' | 'fail'."""
     mode = checkpoint_params.get(CHECKPOINT_TAG_VALIDATION,
                                  CHECKPOINT_TAG_VALIDATION_DEFAULT)
-    mode = mode.upper()
-    if mode in CHECKPOINT_TAG_VALIDATION_MODES:
-        return mode
+    if isinstance(mode, str) and mode.upper() in CHECKPOINT_TAG_VALIDATION_MODES:
+        return mode.upper()
     raise ValueError(
         f"Checkpoint config contains invalid tag_validation value "
         f"{mode!r}, expecting one of {CHECKPOINT_TAG_VALIDATION_MODES}")

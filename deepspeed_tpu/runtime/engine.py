@@ -460,19 +460,13 @@ class DeepSpeedEngine:
             opt_sh_flat = [rep if s is None else NamedSharding(mesh, s)
                            for s in spec_flat]
         else:
-            # client optimizer fallback: scalars replicated, param-shaped
-            # leaves take the spec of the first same-shaped param
-            # (approximate — same-shaped params with different TP layouts
-            # may be mis-matched; implement state_spec() for exactness)
+            from deepspeed_tpu.runtime.utils import opt_shardings_by_shape
+
             flat_param_sh = jax.tree_util.tree_leaves(ns(zero_spec))
             param_shapes = [tuple(l.shape)
                             for l in jax.tree_util.tree_leaves(params_template)]
-            sh_by_shape = {}
-            for shp, sh in zip(param_shapes, flat_param_sh):
-                sh_by_shape.setdefault(shp, sh)
-            opt_sh_flat = [rep if leaf.ndim == 0
-                           else sh_by_shape.get(tuple(leaf.shape), rep)
-                           for leaf in flat_opt]
+            opt_sh_flat = opt_shardings_by_shape(
+                flat_opt, param_shapes, flat_param_sh, rep)
         opt_sh = opt_def.unflatten(opt_sh_flat)
 
         self._shardings = TrainState(
@@ -1116,8 +1110,8 @@ class DeepSpeedEngine:
         digest = int.from_bytes(
             hashlib.sha256(str(tag).encode()).digest()[:4], "big")
         arr = np.asarray([digest], dtype=np.int64)
-        lo = multihost_utils.process_allgather(arr).min()
-        hi = multihost_utils.process_allgather(arr).max()
+        gathered = multihost_utils.process_allgather(arr)
+        lo, hi = gathered.min(), gathered.max()
         if int(lo) != int(hi):
             msg = (f"checkpoint tag {tag!r} is not consistent across "
                    f"processes (hash min {lo} != max {hi})")

@@ -172,15 +172,13 @@ class PipelineEngine(DeepSpeedEngine):
             opt_sh_flat = [rep if s is None else NamedSharding(submesh, s)
                            for s in spec_flat]
         else:
+            from deepspeed_tpu.runtime.utils import opt_shardings_by_shape
+
             zero_flat = jax.tree_util.tree_leaves(zero)
             shapes = [tuple(l.shape) for l in
                       jax.tree_util.tree_leaves(params_template)]
-            by_shape = {}
-            for shp, sh in zip(shapes, zero_flat):
-                by_shape.setdefault(shp, sh)
-            opt_sh_flat = [rep if leaf.ndim == 0
-                           else by_shape.get(tuple(leaf.shape), rep)
-                           for leaf in flat_opt]
+            opt_sh_flat = opt_shardings_by_shape(
+                flat_opt, shapes, zero_flat, rep)
         opt_sh = opt_def.unflatten(opt_sh_flat)
         return param_sh, zero, opt_sh
 

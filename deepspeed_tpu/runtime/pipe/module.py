@@ -133,8 +133,10 @@ class PipelineModule:
         input_fn: batch -> first-stage input (default: batch['x']).
         activation_checkpoint_interval: remat every N layers in the
             sequential path (reference module.py:292-346).
-        seed_layers: give each layer a distinct fold_in seed
-            (reference module.py:85 seed_layers).
+        seed_layers: pin each layer's init to PRNGKey(base_seed + index),
+            reproducible independent of the engine rng (reference
+            module.py:85 seed_layers). Off or on, every layer always folds
+            in its own index so same-shaped layers init differently.
     """
 
     def __init__(self, layers, loss_fn=None, num_stages=None, topology=None,
@@ -182,7 +184,16 @@ class PipelineModule:
         x = self.input_fn(batch)
         counts = []
         for layer in self._layers:
-            lrng = jax.random.fold_in(rng, layer.index if self.seed_layers else 0)
+            # every layer folds in its index: same-shaped layers must never
+            # initialize identically (the reference gets this for free because
+            # torch's global RNG advances per layer, module.py:85).
+            # seed_layers additionally pins each layer to base_seed+index,
+            # independent of the engine rng (reference seed_layers semantics:
+            # layer init reproducible regardless of what ran before it).
+            if self.seed_layers:
+                lrng = jax.random.PRNGKey(self.base_seed + layer.index)
+            else:
+                lrng = jax.random.fold_in(rng, layer.index)
             if layer.param_key is not None and layer.param_key in params:
                 # tied reuse: params exist; just advance the activation
                 x = layer.apply(params[layer.param_key], x, lrng, train=False)

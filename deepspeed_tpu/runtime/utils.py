@@ -216,3 +216,31 @@ def see_memory_usage(message, force=False):
                 f"peak={stats.get('peak_bytes_in_use', 0)/2**30:.2f}GB "
                 f"limit={stats.get('bytes_limit', 0)/2**30:.2f}GB")
     logger.info("\n".join(lines))
+
+
+def opt_shardings_by_shape(flat_opt, param_shapes, flat_param_sh, rep):
+    """Fallback sharding for client-optimizer state leaves (optimizers
+    without ``state_spec``): scalars replicate; a param-shaped leaf takes the
+    sharding of the same-shaped param **only when that mapping is
+    unambiguous** — if two params share a shape but carry different
+    shardings, the leaf replicates (correct, just not partitioned) instead of
+    silently inheriting whichever param flattened first.
+
+    Shared by DeepSpeedEngine._build_shardings and the pipeline engine's
+    per-stage variant. Implement ``state_spec()`` on the optimizer for exact
+    per-param placement.
+    """
+    by_shape = {}
+    ambiguous = set()
+    for shp, sh in zip(param_shapes, flat_param_sh):
+        if shp in by_shape and by_shape[shp] != sh:
+            ambiguous.add(shp)
+        by_shape.setdefault(shp, sh)
+    for shp in ambiguous:
+        logger.warning(
+            f"optimizer-state sharding fallback: params of shape {shp} have "
+            f"conflicting shardings; replicating matching state leaves "
+            f"(define optimizer.state_spec() for exact placement)")
+        by_shape[shp] = rep
+    return [rep if leaf.ndim == 0 else by_shape.get(tuple(leaf.shape), rep)
+            for leaf in flat_opt]

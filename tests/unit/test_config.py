@@ -130,3 +130,17 @@ def test_pld_parsing():
     assert config.pld_enabled
     assert config.pld_gamma == 0.01
     assert config.pld_theta == 1.0
+
+
+def test_checkpoint_tag_validation_non_string_rejected():
+    """Regression: a non-string tag_validation (e.g. bool) used to crash with
+    TypeError on .upper(); it must raise the documented ValueError."""
+    from deepspeed_tpu.runtime.config import get_checkpoint_tag_validation_mode
+    import pytest
+    assert get_checkpoint_tag_validation_mode({}) == "WARN"
+    assert get_checkpoint_tag_validation_mode(
+        {"tag_validation": "fail"}) == "FAIL"
+    with pytest.raises(ValueError):
+        get_checkpoint_tag_validation_mode({"tag_validation": True})
+    with pytest.raises(ValueError):
+        get_checkpoint_tag_validation_mode({"tag_validation": "bogus"})

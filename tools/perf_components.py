@@ -1,0 +1,136 @@
+"""Component timing at bench shapes — find where the 660ms step goes.
+
+Times (each as its own jit, steps pipelined, one sync at end):
+  1. forward loss only
+  2. forward+backward grads
+  3. full fused engine step (micro+apply)
+  4. flash attention kernel alone vs jnp attention at model shapes
+"""
+import os
+import time
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Model, gpt2_config
+
+MODEL = sys.argv[1] if len(sys.argv) > 1 else "gpt2-350m"
+BS = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+SEQ = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+REMAT = bool(int(sys.argv[4])) if len(sys.argv) > 4 else True
+ITERS = 10
+
+
+def timed(name, fn, *args, flops=None, sync=lambda o: jax.device_get(
+        jax.tree_util.tree_leaves(o)[0].ravel()[0])):
+    o = fn(*args)
+    sync(o)  # compile
+    t0 = time.time()
+    for _ in range(ITERS):
+        o = fn(*args)
+    sync(o)
+    dt = (time.time() - t0) / ITERS
+    tf = f" {flops/dt/1e12:7.1f} TFLOPS" if flops else ""
+    print(f"{name:34s} {dt*1000:8.1f} ms{tf}", flush=True)
+    return dt
+
+
+def main():
+    cfg = gpt2_config(MODEL, n_positions=SEQ, dtype=jnp.bfloat16,
+                      remat=REMAT, scan_layers=True)
+    model = GPT2Model(cfg)
+    ds_config = {
+        "train_batch_size": BS,
+        "train_micro_batch_size_per_gpu": BS,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"data": 1, "model": 1, "pipe": 1},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                               config_params=ds_config)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (1, BS, SEQ))
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    n_params = None
+
+    # engine full step first (it builds state)
+    def full_step():
+        return engine.train_batch(batch=batch)
+
+    o = full_step()
+    jax.device_get(o)
+    n_params = model.num_params(engine.state.params)
+    model_flops = 6.0 * n_params * BS * SEQ
+    t0 = time.time()
+    for _ in range(ITERS):
+        o = full_step()
+    jax.device_get(o)
+    dt = (time.time() - t0) / ITERS
+    print(f"{'engine.train_batch':34s} {dt*1000:8.1f} ms "
+          f"{model_flops/dt/1e12:7.1f} TFLOPS  "
+          f"(params={n_params/1e6:.1f}M remat={REMAT} bs={BS} seq={SEQ})",
+          flush=True)
+
+    params = engine.state.params
+    dev_batch = engine._shard_batch(batch)
+    dev_micro = {k: v[0] for k, v in dev_batch.items()}
+    key = jax.random.PRNGKey(0)
+
+    with jax.set_mesh(engine.mesh):
+        fwd = jax.jit(lambda p, b: model.loss(p, b, key, train=True)[0])
+        timed("fwd loss", fwd, params, dev_micro,
+              flops=2.0 * n_params * BS * SEQ)
+
+        def loss_fn(p, b):
+            return model.loss(p, b, key, train=True)[0].astype(jnp.float32)
+
+        grad = jax.jit(lambda p, b: jax.grad(loss_fn)(p, b))
+        timed("fwd+bwd grads", grad, params, dev_micro,
+              flops=6.0 * n_params * BS * SEQ)
+
+        # apply step alone
+        state = engine.state
+        apply_ = jax.jit(engine._make_apply_fn(),
+                         out_shardings=(engine._shardings, None))
+        timed("apply (adam+cast)", apply_, state, jnp.float32(1e-4))
+
+        # batch transfer cost
+        t0 = time.time()
+        for _ in range(ITERS):
+            db = engine._shard_stacked_batch(batch)
+        jax.device_get(jax.tree_util.tree_leaves(db)[0].ravel()[0])
+        print(f"{'_shard_stacked_batch (h2d)':34s} "
+              f"{(time.time()-t0)/ITERS*1000:8.1f} ms", flush=True)
+
+    # attention kernels at model shape
+    H, D = cfg.n_head, cfg.head_dim
+    q = jnp.asarray(rng.standard_normal((BS, H, SEQ, D)), jnp.bfloat16)
+    from deepspeed_tpu.ops.transformer.functional import (
+        scaled_dot_product_attention)
+    att_flops = 4.0 * BS * H * SEQ * SEQ * D  # qk + pv, fwd only
+    pallas = jax.jit(lambda q, k, v: scaled_dot_product_attention(
+        q, k, v, causal=True, use_pallas=True))
+    timed("flash attn fwd (pallas)", pallas, q, q, q, flops=att_flops)
+    ref = jax.jit(lambda q, k, v: scaled_dot_product_attention(
+        q, k, v, causal=True, use_pallas=False))
+    timed("attn fwd (jnp)", ref, q, q, q, flops=att_flops)
+
+    pallas_g = jax.jit(jax.grad(lambda q, k, v: scaled_dot_product_attention(
+        q, k, v, causal=True, use_pallas=True).astype(jnp.float32).sum()))
+    timed("flash attn fwd+bwd (pallas)", pallas_g, q, q, q,
+          flops=3.5 * att_flops)
+    ref_g = jax.jit(jax.grad(lambda q, k, v: scaled_dot_product_attention(
+        q, k, v, causal=True, use_pallas=False).astype(jnp.float32).sum()))
+    timed("attn fwd+bwd (jnp)", ref_g, q, q, q, flops=3.5 * att_flops)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,109 @@
+"""Where does the forward go? Times model sections + attention kernels."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.models.gpt2 import Block, GPT2LMHead, gpt2_config
+from deepspeed_tpu.ops.transformer.functional import (
+    scaled_dot_product_attention)
+
+MODEL = sys.argv[1] if len(sys.argv) > 1 else "gpt2-350m"
+BS = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+SEQ = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+ITERS = 20
+
+
+def timed(name, fn, *args, flops=None):
+    o = fn(*args)
+    jax.block_until_ready(o)
+    jax.device_get(jax.tree_util.tree_leaves(o)[0].ravel()[0])
+    t0 = time.time()
+    for _ in range(ITERS):
+        o = fn(*args)
+    jax.device_get(jax.tree_util.tree_leaves(o)[0].ravel()[0])
+    dt = (time.time() - t0) / ITERS
+    tf = f" {flops/dt/1e12:7.1f} TFLOPS" if flops else ""
+    print(f"{name:40s} {dt*1000:8.2f} ms{tf}", flush=True)
+    return dt
+
+
+def main():
+    cfg = gpt2_config(MODEL, n_positions=SEQ, dtype=jnp.bfloat16,
+                      remat=False, scan_layers=False)
+    rng = np.random.default_rng(0)
+    E, H, D, L, V = cfg.n_embd, cfg.n_head, cfg.head_dim, cfg.n_layer, cfg.vocab_size
+
+    # --- single block fwd ---
+    x = jnp.asarray(rng.standard_normal((BS, SEQ, E)), jnp.bfloat16)
+    blk = Block(cfg)
+    bp = blk.init(jax.random.PRNGKey(0), x, False)
+    blk_fwd = jax.jit(lambda p, x: blk.apply(p, x, False))
+    blk_flops = 2 * BS * SEQ * (3*E*E + E*E + 8*E*E) + 4*BS*H*SEQ*SEQ*D
+    timed("block fwd (pallas attn)", blk_fwd, bp, x, flops=blk_flops)
+
+    cfg_np = gpt2_config(MODEL, n_positions=SEQ, dtype=jnp.bfloat16,
+                         remat=False, use_pallas_attention=False)
+    blk2 = Block(cfg_np)
+    blk2_fwd = jax.jit(lambda p, x: blk2.apply(p, x, False))
+    timed("block fwd (jnp attn)", blk2_fwd, bp, x, flops=blk_flops)
+
+    # --- attention alone ---
+    q = jnp.asarray(rng.standard_normal((BS, H, SEQ, D)), jnp.bfloat16)
+    att_flops = 4.0 * BS * H * SEQ * SEQ * D
+    pal = jax.jit(lambda q, k, v: scaled_dot_product_attention(
+        q, k, v, causal=True, use_pallas=True))
+    timed("attn fwd pallas", pal, q, q, q, flops=att_flops)
+    ref = jax.jit(lambda q, k, v: scaled_dot_product_attention(
+        q, k, v, causal=True, use_pallas=False))
+    timed("attn fwd jnp", ref, q, q, q, flops=att_flops)
+
+    palg = jax.jit(jax.grad(lambda q, k, v: scaled_dot_product_attention(
+        q, k, v, causal=True, use_pallas=True).astype(jnp.float32).sum()))
+    timed("attn fwd+bwd pallas", palg, q, q, q, flops=3.5*att_flops)
+    refg = jax.jit(jax.grad(lambda q, k, v: scaled_dot_product_attention(
+        q, k, v, causal=True, use_pallas=False).astype(jnp.float32).sum()))
+    timed("attn fwd+bwd jnp", refg, q, q, q, flops=3.5*att_flops)
+
+    # --- embedding + logits + loss (no blocks) ---
+    ids = jnp.asarray(rng.integers(0, V, (BS, SEQ)), jnp.int32)
+    wte = jnp.asarray(rng.standard_normal((V, E)) * 0.02, jnp.float32)
+
+    def head_only(wte, ids):
+        x = wte.astype(jnp.bfloat16)[ids]
+        logits = jnp.einsum("bse,ve->bsv", x, wte.astype(jnp.bfloat16))
+        from deepspeed_tpu.models.api import cross_entropy_loss
+        loss, _ = cross_entropy_loss(logits[:, :-1], ids[:, 1:],
+                                     ignore_index=-100)
+        return loss
+
+    head_flops = 2 * BS * SEQ * V * E
+    timed("embed+logits+xent fwd", jax.jit(head_only), wte, ids,
+          flops=head_flops)
+    timed("embed+logits+xent fwd+bwd",
+          jax.jit(jax.grad(head_only)), wte, ids, flops=3*head_flops)
+
+    # --- full fwd, blocks only (no vocab head) ---
+    class BlocksOnly(nn.Module):
+        config: object
+
+        @nn.compact
+        def __call__(self, x):
+            for i in range(self.config.n_layer):
+                x = Block(self.config, name=f"h_{i}")(x, False)
+            return x
+
+    m = BlocksOnly(cfg)
+    mp = m.init(jax.random.PRNGKey(0), x)
+    timed(f"{L} blocks fwd", jax.jit(lambda p, x: m.apply(p, x)), mp, x,
+          flops=L*blk_flops)
+
+
+if __name__ == "__main__":
+    main()

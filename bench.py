@@ -79,10 +79,24 @@ def run_worker(args) -> int:
               f"publish a bogus perf number", file=sys.stderr, flush=True)
         return 3
 
-    cfg = gpt2_config(args.model, n_positions=args.seq, dtype=jnp.bfloat16,
-                      remat=bool(args.remat),
-                      scan_layers=bool(args.scan_layers))
-    model = GPT2Model(cfg)
+    if args.model.startswith("bert"):
+        # BERT-large seq128 is the reference's 64-TFLOPS/V100 headline
+        # (docs/_posts/2020-05-28-fastest-bert-training.md:15-40); dropout 0
+        # for a deterministic kernel-path bench (the fused layer dispatches
+        # the Pallas flash kernel with the additive key-padding mask)
+        from deepspeed_tpu.models.bert import BertForPreTraining, bert_config
+
+        cfg = bert_config(args.model, max_position_embeddings=args.seq,
+                          dtype=jnp.bfloat16, remat=bool(args.remat),
+                          hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0)
+        model = BertForPreTraining(cfg)
+    else:
+        cfg = gpt2_config(args.model, n_positions=args.seq,
+                          dtype=jnp.bfloat16, remat=bool(args.remat),
+                          scan_layers=bool(args.scan_layers),
+                          loss_chunk_tokens=args.loss_chunk)
+        model = GPT2Model(cfg)
 
     ds_config = {
         "train_batch_size": args.batch * n_dev,
@@ -101,7 +115,16 @@ def run_worker(args) -> int:
     rng = np.random.default_rng(0)
     global_bs = args.batch * n_dev
     ids = rng.integers(0, cfg.vocab_size, (1, global_bs, args.seq))
-    batch = {"input_ids": ids, "labels": ids.copy()}
+    if args.model.startswith("bert"):
+        # MLM: 15% of positions carry labels, rest are ignored (-100)
+        labels = np.where(rng.random((1, global_bs, args.seq)) < 0.15,
+                          ids, -100)
+        batch = {"input_ids": ids,
+                 "attention_mask": np.ones((1, global_bs, args.seq),
+                                           np.int32),
+                 "masked_lm_labels": labels}
+    else:
+        batch = {"input_ids": ids, "labels": ids.copy()}
 
     t0 = time.time()
     loss = engine.train_batch(batch=batch)  # always >=1 step: compile here
@@ -163,7 +186,7 @@ def run_worker(args) -> int:
 def _attempt_cmd(base, spec):
     cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
     for k in ("model", "batch", "seq", "steps", "warmup", "scan_layers",
-              "remat", "allow_cpu"):
+              "remat", "allow_cpu", "loss_chunk"):
         cmd += [f"--{k}", str(spec.get(k, getattr(base, k)))]
     return cmd
 
@@ -258,6 +281,8 @@ def main():
     p.add_argument("--scan_layers", type=int, default=1)
     p.add_argument("--remat", type=int, default=1)
     p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--loss_chunk", type=int, default=8192,
+                   help="chunked LM-head xent tokens (0 = dense logits)")
     p.add_argument("--seq", type=int, default=1024)
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)

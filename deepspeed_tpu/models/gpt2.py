@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.models.api import cross_entropy_loss
+from deepspeed_tpu.models.api import (chunked_lm_cross_entropy,
+                                      cross_entropy_loss)
 from deepspeed_tpu.ops.transformer.functional import scaled_dot_product_attention
 from deepspeed_tpu.parallel import mesh as mesh_lib
 
@@ -38,6 +39,10 @@ class GPT2Config:
     scan_layers: bool = False      # lax.scan over blocks: compile time O(1)
                                    # in depth, params stacked (L, ...)
     use_pallas_attention: Optional[bool] = None  # None = auto
+    loss_chunk_tokens: int = 8192  # chunked LM-head xent (0 = dense logits);
+                                   # keeps peak memory O(chunk*V) not O(B*S*V).
+                                   # 8192 on v5e: scan overhead amortized to
+                                   # parity with the dense head (round-4 sweep)
 
     @property
     def head_dim(self):
@@ -125,7 +130,8 @@ class GPT2LMHead(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, train: bool = False):
+    def __call__(self, input_ids, train: bool = False,
+                 return_hidden: bool = False):
         cfg = self.config
         B, S = input_ids.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
@@ -158,6 +164,10 @@ class GPT2LMHead(nn.Module):
                 x = block(cfg, name=f"h_{i}")(x, train)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                          name="ln_f")(x)
+        if return_hidden:
+            # training loss path: the chunked xent applies the tied head
+            # itself without materializing full logits
+            return x, wte
         # tied LM head: logits against the embedding matrix
         logits = jnp.einsum("bse,ve->bsv", x, wte.astype(cfg.dtype))
         return logits
@@ -204,6 +214,15 @@ class GPT2Model:
                                 batch["input_ids"], train=False)["params"]
 
     def loss(self, params, batch, rng, train=True):
+        chunk = self.config.loss_chunk_tokens
+        if chunk:
+            hidden, wte = self.module.apply(
+                {"params": params}, batch["input_ids"], train=train,
+                return_hidden=True, rngs={"dropout": rng})
+            # next-token LM loss, chunked head (no full-logits residual)
+            return chunked_lm_cross_entropy(
+                hidden[:, :-1], wte, batch["labels"][:, 1:],
+                chunk_tokens=chunk, ignore_index=-100)
         logits = self.module.apply({"params": params}, batch["input_ids"],
                                    train=train, rngs={"dropout": rng})
         # next-token LM loss

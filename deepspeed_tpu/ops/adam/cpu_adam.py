@@ -55,15 +55,22 @@ class DeepSpeedCPUAdam:
         lr = self.lr if lr is None else lr
         state["step"] += 1
         step = state["step"]
-        for p_orig, g, m, v in zip(master_leaves, grad_leaves, state["m"],
-                                   state["v"]):
-            p = p_orig
-            copied = False
-            if not p.flags["C_CONTIGUOUS"]:
-                # the kernel needs contiguous memory; update the copy and
-                # write back so the promised in-place semantics hold
-                p = np.ascontiguousarray(p)
-                copied = True
+        for p_orig, g, m_orig, v_orig in zip(master_leaves, grad_leaves,
+                                             state["m"], state["v"]):
+            # the kernel needs contiguous memory; shard-local offload may
+            # pass non-contiguous views (e.g. a dim-1 slice of a TP-sharded
+            # leaf) — update a copy and write back so the promised in-place
+            # semantics hold
+            views = []
+            bufs = []
+            for orig in (p_orig, m_orig, v_orig):
+                if orig.flags["C_CONTIGUOUS"]:
+                    views.append(None)
+                    bufs.append(orig)
+                else:
+                    views.append(orig)
+                    bufs.append(np.ascontiguousarray(orig))
+            p, m, v = bufs
             g32 = np.ascontiguousarray(g, dtype=np.float32)
             if self._lib is not None:
                 self._lib.ds_adam_step(
@@ -73,8 +80,9 @@ class DeepSpeedCPUAdam:
                     int(self.bias_correction), step, grad_scale)
             else:
                 self._numpy_step(p, g32, m, v, lr, step, grad_scale)
-            if copied:
-                p_orig[...] = p
+            for orig, buf in zip(views, bufs):
+                if orig is not None:
+                    orig[...] = buf
         return state
 
     def _numpy_step(self, p, g, m, v, lr, step, grad_scale):

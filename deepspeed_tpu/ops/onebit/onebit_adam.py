@@ -38,8 +38,8 @@ class OnebitAdam:
     def __init__(self, lr=1e-3, freeze_step=100000, betas=(0.9, 0.999),
                  eps=1e-8, weight_decay=0.0, max_grad_norm=0.0,
                  bias_correction=True, amsgrad=False, cuda_aware=False,
-                 eps_inside_sqrt=False, mesh=None, axis_name=None,
-                 axis_size=1):
+                 eps_inside_sqrt=False, comm_backend_name="xla", mesh=None,
+                 axis_name=None, axis_size=1):
         assert not amsgrad, "1-bit Adam does not support the AMSGrad variant."
         self.lr = lr
         self.freeze_step = freeze_step
@@ -47,25 +47,42 @@ class OnebitAdam:
         self.eps = eps
         self.weight_decay = weight_decay
         self.mesh = mesh
+        # reference parity: comm_backend_name selects the wire
+        # ('nccl'/'mpi' there; 'xla' here). 'none' opts out of the
+        # shard_map wire path even when the engine would enable it.
+        self.comm_backend_name = comm_backend_name
         # when set, update() runs under shard_map with this axis bound and
         # uses the true bit-packed collective instead of local quantization;
         # axis_size is needed at trace time to pad leaves (the reference's
-        # corrected_tensor_size, onebit_adam.py:293-298)
+        # corrected_tensor_size, onebit_adam.py:293-298).  Error-feedback
+        # residuals are per-device: they carry a leading (axis_size,) dim
+        # sharded over the axis.
         self.axis_name = axis_name
         self.axis_size = axis_size
 
     def init_state(self, master_params) -> OnebitAdamState:
         zeros = lambda: jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), master_params)
+        if self.axis_name is not None:
+            # per-device residuals: leading axis dim, sharded over the axis
+            err = lambda: jax.tree_util.tree_map(
+                lambda p: jnp.zeros((self.axis_size,) + p.shape, jnp.float32),
+                master_params)
+        else:
+            err = zeros
         return OnebitAdamState(step=jnp.int32(0), m=zeros(), v=zeros(),
-                               worker_error=zeros(), server_error=zeros())
+                               worker_error=err(), server_error=err())
 
     def update(self, grads, state: OnebitAdamState, master_params, lr=None,
-               scale=1.0):
+               scale=1.0, frozen=None):
+        """One optimizer step. ``frozen`` statically selects the branch
+        (None = runtime lax.cond on step vs freeze_step); the engine compiles
+        warmup and post-freeze as separate programs so the post-freeze HLO
+        contains only the bit-packed collective."""
         lr = self.lr if lr is None else lr
         step = state.step + 1
         b1, b2 = self.beta1, self.beta2
-        frozen = step > self.freeze_step  # variance freezes after warmup
+        dyn_frozen = step > self.freeze_step  # variance freezes after warmup
 
         def leaf(g, m, v, we, se, p):
             g = g.astype(jnp.float32) / scale
@@ -98,10 +115,17 @@ class OnebitAdam:
                 v_warm = b2 * v + (1.0 - b2) * jnp.square(g_sync)
                 return m_warm, v_warm, we, se
 
-            # lax.cond so warmup steps skip the quantization (and its
-            # collectives) entirely instead of computing-and-discarding
-            m_out, v_out, we_out, se_out = jax.lax.cond(
-                frozen, compressed, warmup, None)
+            # static frozen compiles exactly one branch (the engine swaps
+            # programs at the freeze boundary — the post-freeze HLO then
+            # provably contains no dense gradient collective); dynamic falls
+            # back to lax.cond so warmup steps still skip the quantization
+            if frozen is None:
+                m_out, v_out, we_out, se_out = jax.lax.cond(
+                    dyn_frozen, compressed, warmup, None)
+            elif frozen:
+                m_out, v_out, we_out, se_out = compressed(None)
+            else:
+                m_out, v_out, we_out, se_out = warmup(None)
 
             update = m_out / (jnp.sqrt(v_out) + self.eps)
             if self.weight_decay > 0.0:
@@ -120,6 +144,14 @@ class OnebitAdam:
                                       worker_error=new_we, server_error=new_se)
 
     def state_spec(self, param_specs):
+        from jax.sharding import PartitionSpec as P
+
+        err_specs = param_specs
+        if self.axis_name is not None:
+            # residuals carry a leading per-device dim sharded over the axis
+            err_specs = jax.tree_util.tree_map(
+                lambda s: P(self.axis_name, *s), param_specs,
+                is_leaf=lambda x: isinstance(x, P))
         return OnebitAdamState(step=None, m=param_specs, v=param_specs,
-                               worker_error=param_specs,
-                               server_error=param_specs)
+                               worker_error=err_specs,
+                               server_error=err_specs)

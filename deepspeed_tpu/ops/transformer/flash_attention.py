@@ -18,9 +18,36 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# tuned on TPU v5e at (8, 16, 1024, 64): 512/1024 reached 22 TF fwd /
+# 45 TF fwd+bwd vs 13.6/25 for the fused-XLA jnp path (tools/flash_tune2.py);
+# blocks are clamped to the sequence length at call time
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
+
+
+def _dot(a, b, dims):
+    """MXU dot: native (bf16) inputs, fp32 accumulation. Casting inputs to
+    fp32 first would force fp32 MXU passes at a fraction of bf16 throughput —
+    the round-4 profile showed exactly that (kernel slower than the jnp
+    path); inputs stay in their storage dtype and only the accumulator is
+    fp32."""
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _fit_block(block, seq):
+    """Largest lane-aligned block <= `block` that divides `seq` (whole
+    `seq` if smaller); None when no 128-aligned divisor exists — degenerate
+    sub-tile blocks would fail deep in Mosaic or crawl, so the caller
+    raises loudly instead."""
+    if seq <= block:
+        return seq
+    while block >= 128:
+        if seq % block == 0:
+            return block
+        block //= 2
+    return None
 
 
 def _interpret_default() -> bool:
@@ -30,12 +57,46 @@ def _interpret_default() -> bool:
         return True
 
 
+def _apply_bias(s, bias_ref, bias_kind):
+    """Additive attention bias inside a kernel block.
+
+    bias_kind 'key': bias_ref block is (1, block_k) — the HF extended-mask
+    (B, 1, 1, S_k) case, broadcast over query rows; 'full': (1, block_q,
+    block_k) per-(batch*head) scores bias."""
+    if bias_kind == "key":
+        return s + bias_ref[...]
+    if bias_kind == "full":
+        return s + bias_ref[0]
+    return s
+
+
+def _bias_specs(bias, bias_kind, num_heads, block_q, block_k, qmap, kmap):
+    """(operands, in_specs) for the optional bias input. qmap/kmap map grid
+    ids to the bias q/k block index."""
+    if bias_kind == "none":
+        return [], []
+    if bias_kind == "key":
+        spec = pl.BlockSpec(
+            (1, block_k),
+            lambda b, i, j: (b // num_heads, kmap(i, j)))
+        return [bias], [spec]
+    spec = pl.BlockSpec(
+        (1, block_q, block_k),
+        lambda b, i, j: (b, qmap(i, j), kmap(i, j)))
+    return [bias], [spec]
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr,
-                *, scale, causal, block_q, block_k, num_k_blocks):
+def _fwd_kernel(*refs, scale, causal, bias_kind, block_q, block_k,
+                num_k_blocks):
+    if bias_kind == "none":
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        bias_ref = None
+    else:
+        (q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -54,12 +115,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)            # [bq, d]
-        k = k_ref[0].astype(jnp.float32)            # [bk, d]
-        v = v_ref[0].astype(jnp.float32)            # [bk, d]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale     # [bq, bk]
+        q = q_ref[0]                                # [bq, d] storage dtype
+        k = k_ref[0]                                # [bk, d]
+        v = v_ref[0]                                # [bk, d]
+        s = _dot(q, k, ((1,), (1,))) * scale                 # [bq, bk] f32
+        s = _apply_bias(s, bias_ref, bias_kind)
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -70,11 +130,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_cur = jnp.max(s, axis=-1, keepdims=True)           # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)                      # [bq, 1]
-        p = jnp.exp(s - m_new)                               # [bq, bk]
+        p = jnp.exp(s - m_new)                               # [bq, bk] f32
         l_new = l_scr[:, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + _dot(
+            p.astype(v.dtype), v, ((1,), (0,)))
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -87,7 +146,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, bias, *, scale, causal, bias_kind, num_heads,
+               block_q, block_k, interpret):
     bh, s_q, d = q.shape
     s_k = k.shape[1]
     block_q = min(block_q, s_q)
@@ -96,8 +156,11 @@ def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
     nk = pl.cdiv(s_k, block_k)
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
+        _fwd_kernel, scale=scale, causal=causal, bias_kind=bias_kind,
         block_q=block_q, block_k=block_k, num_k_blocks=nk)
+    bias_ops, bias_specs = _bias_specs(
+        bias, bias_kind, num_heads, block_q, block_k,
+        qmap=lambda i, j: i, kmap=lambda i, j: j)
 
     out, lse = pl.pallas_call(
         kernel,
@@ -106,7 +169,7 @@ def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        ] + bias_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
@@ -120,17 +183,25 @@ def _flash_fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, *bias_ops)
     return out, lse[:, :, 0]
 
 
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
-def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dk_ref, dv_ref, dk_scr, dv_scr,
-                     *, scale, causal, block_q, block_k, num_q_blocks):
+def _bwd_dkdv_kernel(*refs, scale, causal, bias_kind, block_q, block_k,
+                     num_q_blocks):
+    if bias_kind == "none":
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        bias_ref = None
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -147,31 +218,24 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0:1]                       # [bq, 1]
         delta = delta_ref[0][:, 0:1]                   # [bq, 1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale       # [bq, bk]
+        s = _dot(q, k, ((1,), (1,))) * scale                  # [bq, bk] f32
+        s = _apply_bias(s, bias_ref, bias_kind)
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             mask = (q_start + rows) >= (k_start + cols)
             s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse)                                  # [bq, bk]
-        dv_scr[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)               # [bk, d]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)               # [bq, bk]
+        p = jnp.exp(s - lse)                                  # [bq, bk] f32
+        dv_scr[:] += _dot(p.astype(do.dtype), do, ((0,), (0,)))   # [bk, d]
+        dp = _dot(do, v, ((1,), (1,)))                        # [bq, bk] f32
         ds = p * (dp - delta) * scale
-        dk_scr[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)               # [bk, d]
+        dk_scr[:] += _dot(ds.astype(q.dtype), q, ((0,), (0,)))   # [bk, d]
 
     @pl.when(qi == num_q_blocks - 1)
     def _finalize():
@@ -179,9 +243,15 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr,
-                   *, scale, causal, block_q, block_k, num_k_blocks):
+def _bwd_dq_kernel(*refs, scale, causal, bias_kind, block_q, block_k,
+                   num_k_blocks):
+    if bias_kind == "none":
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scr) = refs
+        bias_ref = None
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
+         dq_ref, dq_scr) = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -197,36 +267,32 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0:1]
         delta = delta_ref[0][:, 0:1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+        s = _dot(q, k, ((1,), (1,))) * scale
+        s = _apply_bias(s, bias_ref, bias_kind)
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
             mask = (q_start + rows) >= (k_start + cols)
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dp = _dot(do, v, ((1,), (1,)))
         ds = p * (dp - delta) * scale
-        dq_scr[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dq_scr[:] += _dot(ds.astype(k.dtype), k, ((1,), (0,)))
 
     @pl.when(ki == num_k_blocks - 1)
     def _finalize():
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
-    q, k, v, out, lse = res
+def _flash_bwd(res, g, *, scale, causal, bias_kind, num_heads, block_q,
+               block_k, interpret):
+    q, k, v, bias, out, lse = res
     do = g
     bh, s_q, d = q.shape
     s_k = k.shape[1]
@@ -240,8 +306,13 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
     lse_w = jnp.broadcast_to(lse[:, :, None], (bh, s_q, 128)).astype(jnp.float32)
     delta_w = jnp.broadcast_to(delta[:, :, None], (bh, s_q, 128))
 
+    # dkdv grid is (bh, k-block, q-block): bias maps transposed
+    bias_ops, bias_specs = _bias_specs(
+        bias, bias_kind, num_heads, block_q, block_k,
+        qmap=lambda j, i: i, kmap=lambda j, i: j)
     dkdv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
+                          bias_kind=bias_kind,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq),
         grid=(bh, nk, nq),
         in_specs=[
@@ -251,7 +322,7 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
-        ],
+        ] + bias_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -264,12 +335,18 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse_w, delta_w)
+    )(q, k, v, do, lse_w, delta_w, *bias_ops)
     dk, dv = dkdv
 
+    bias_ops, bias_specs = _bias_specs(
+        bias, bias_kind, num_heads, block_q, block_k,
+        qmap=lambda i, j: i, kmap=lambda i, j: j)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bias_kind=bias_kind,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk),
         grid=(bh, nq, nk),
         in_specs=[
@@ -279,48 +356,66 @@ def _flash_bwd(res, g, *, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
-        ],
+        ] + bias_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse_w, delta_w)
+    )(q, k, v, do, lse_w, delta_w, *bias_ops)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention_3d(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, scale=scale, causal=causal,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash_attention_3d(q, k, v, bias, scale, causal, bias_kind, num_heads,
+                        block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, bias, scale=scale, causal=causal,
+                        bias_kind=bias_kind, num_heads=num_heads,
                         block_q=block_q, block_k=block_k, interpret=interpret)
     return out
 
 
-def _flash_3d_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, interpret=interpret)
-    return out, (q, k, v, out, lse)
+def _flash_3d_fwd(q, k, v, bias, scale, causal, bias_kind, num_heads,
+                  block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, bias, scale=scale, causal=causal,
+                          bias_kind=bias_kind, num_heads=num_heads,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret)
+    return out, (q, k, v, bias, out, lse)
 
 
-def _flash_3d_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    return _flash_bwd(res, g, scale=scale, causal=causal,
-                      block_q=block_q, block_k=block_k, interpret=interpret)
+def _flash_3d_bwd(scale, causal, bias_kind, num_heads, block_q, block_k,
+                  interpret, res, g):
+    dq, dk, dv = _flash_bwd(res, g, scale=scale, causal=causal,
+                            bias_kind=bias_kind, num_heads=num_heads,
+                            block_q=block_q, block_k=block_k,
+                            interpret=interpret)
+    # bias is a constant additive mask (HF extended mask / key padding):
+    # no gradient is produced for it (zeros keep the vjp total)
+    dbias = None if res[3] is None else jnp.zeros_like(res[3])
+    return dq, dk, dv, dbias
 
 
+# nondiff args start at 4: scale, causal, bias_kind, num_heads, blocks, interpret
 _flash_attention_3d.defvjp(_flash_3d_fwd, _flash_3d_bwd)
 
 
-def flash_attention(q, k, v, *, causal: bool = False,
+def flash_attention(q, k, v, *, bias=None, causal: bool = False,
                     scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: Optional[bool] = None):
     """Flash attention over [batch, heads, seq, head_dim] tensors.
 
-    Differentiable (custom VJP with blockwise recomputation).  On non-TPU
-    backends runs in Pallas interpreter mode (slow; tests only).
+    bias: optional ADDITIVE attention bias — (B, 1, 1, S_k) HF extended
+    mask / key-padding form, or any shape broadcastable to (B, H, S_q, S_k).
+    Treated as a constant (no bias gradient). Differentiable in q/k/v
+    (custom VJP with blockwise recomputation). On non-TPU backends runs in
+    Pallas interpreter mode (slow; tests only).
     """
     if interpret is None:
         interpret = _interpret_default()
@@ -331,14 +426,34 @@ def flash_attention(q, k, v, *, causal: bool = False,
     assert not causal or s_q == s_k, (
         f"causal flash attention requires equal q/k lengths, got ({s_q}, {s_k}); "
         f"use the jnp path for cross-length (decode) attention")
-    assert s_q % min(block_q, s_q) == 0 and s_k % min(block_k, s_k) == 0, (
-        f"seq lengths ({s_q}, {s_k}) must divide into blocks "
-        f"({block_q}, {block_k}); pad the sequence or use the jnp path — "
-        f"padded Pallas blocks would silently corrupt the softmax")
+    # shrink each block to the largest 128-aligned divisor of the sequence
+    # length: any s % 128 == 0 stays on the kernel (e.g. 640 uses
+    # 128-blocks rather than failing the 512-default divisibility — partial
+    # Pallas blocks would silently corrupt the softmax, so divisibility is
+    # non-negotiable and unaligned lengths fail loudly)
+    block_q = _fit_block(block_q, s_q)
+    block_k = _fit_block(block_k, s_k)
+    assert block_q is not None and block_k is not None, (
+        f"seq lengths ({s_q}, {s_k}) have no 128-aligned block divisor; "
+        f"pad the sequence to a multiple of 128 or use the jnp path")
     scale = (d ** -0.5) if scale is None else scale
+    bias_kind = "none"
+    bias3 = None
+    if bias is not None:
+        assert bias.ndim == 4, f"bias must be 4D, got shape {bias.shape}"
+        if bias.shape[1] == 1 and bias.shape[2] == 1:
+            # key-padding bias: one row per batch, broadcast over heads/rows
+            bias_kind = "key"
+            bias3 = jnp.broadcast_to(
+                bias[:, 0, 0, :], (b, s_k)).astype(jnp.float32)
+        else:
+            bias_kind = "full"
+            bias3 = jnp.broadcast_to(
+                bias, (b, h, s_q, s_k)).astype(jnp.float32).reshape(
+                    b * h, s_q, s_k)
     q3 = q.reshape(b * h, s_q, d)
     k3 = k.reshape(b * h, k.shape[2], d)
     v3 = v.reshape(b * h, v.shape[2], d)
-    out = _flash_attention_3d(q3, k3, v3, scale, causal, block_q, block_k,
-                              interpret)
+    out = _flash_attention_3d(q3, k3, v3, bias3, scale, causal, bias_kind,
+                              h, block_q, block_k, interpret)
     return out.reshape(b, h, s_q, d)

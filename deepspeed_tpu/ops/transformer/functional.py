@@ -25,12 +25,12 @@ def scaled_dot_product_attention(q, k, v, *, mask=None, bias=None, causal=False,
     if use_pallas is None:
         use_pallas = _pallas_attention_ok(q, k, v, mask, bias, dropout_rate)
     if use_pallas:
-        assert mask is None and bias is None and dropout_rate == 0.0, (
-            "pallas flash attention supports causal masking only; mask/bias/"
-            "dropout require use_pallas=False (jnp path)")
+        assert mask is None and dropout_rate == 0.0, (
+            "pallas flash attention supports causal masking and additive "
+            "bias; boolean mask/dropout require use_pallas=False (jnp path)")
         from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        return flash_attention(q, k, v, bias=bias, causal=causal, scale=scale)
 
     head_dim = q.shape[-1]
     scale = (head_dim ** -0.5) if scale is None else scale
@@ -53,10 +53,21 @@ def scaled_dot_product_attention(q, k, v, *, mask=None, bias=None, causal=False,
 
 
 def _pallas_attention_ok(q, k, v, mask, bias, dropout_rate) -> bool:
-    # Pallas path: TPU backend, no arbitrary mask/bias/dropout (causal handled
-    # in-kernel), seq and head_dim aligned to MXU tiles.
-    if mask is not None or bias is not None or dropout_rate > 0.0:
+    # Pallas path: TPU backend, no boolean mask / dropout (causal and
+    # additive bias handled in-kernel), seq and head_dim aligned to MXU
+    # tiles. Bias must be 4D and broadcastable to (B, H, S_q, S_k); its
+    # gradient is not produced (fine for constant masks — a learned bias
+    # needs use_pallas=False).
+    if mask is not None or dropout_rate > 0.0:
         return False
+    if bias is not None:
+        # auto-dispatch only for key-padding-shaped biases (B, 1, 1, S_k) —
+        # in practice always constant masks. A full (learned) bias would
+        # silently get zero gradient through the kernel; it must opt in
+        # with use_pallas=True.
+        if getattr(bias, "ndim", 0) != 4 or bias.shape[1] != 1 \
+                or bias.shape[2] != 1:
+            return False
     try:
         if jax.default_backend() not in ("tpu",):
             return False

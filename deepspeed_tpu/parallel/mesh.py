@@ -89,7 +89,10 @@ def build_mesh(mesh_shape: Optional[dict] = None, devices=None):
 def constrain(x, spec):
     """with_sharding_constraint that no-ops when no mesh is active or the
     referenced axes are absent/trivial — lets model code carry sharding
-    annotations that only bind inside an engine's mesh context."""
+    annotations that only bind inside an engine's mesh context. Inside
+    shard_map, axes the map handles manually (e.g. 'data' in the 1-bit Adam
+    wire step) are dropped: the data is already device-local there, and
+    with_sharding_constraint rejects specs naming manual axes."""
     import jax
 
     from jax.sharding import PartitionSpec as P
@@ -97,17 +100,28 @@ def constrain(x, spec):
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty:
         return x
+    manual = set(getattr(mesh, "manual_axes", ()) or ())
 
     def keep(axis):
         if axis is None:
             return None
         axes = axis if isinstance(axis, tuple) else (axis,)
-        kept = tuple(a for a in axes if a in mesh.shape)
+        kept = tuple(a for a in axes if a in mesh.shape and a not in manual)
         if not kept:
             return None
         return kept if len(kept) > 1 else kept[0]
 
-    return jax.lax.with_sharding_constraint(x, P(*(keep(a) for a in spec)))
+    cleaned = P(*(keep(a) for a in spec))
+    if all(a is None for a in cleaned):
+        return x
+    try:
+        return jax.lax.with_sharding_constraint(x, cleaned)
+    except ValueError as e:
+        if "Auto axes" in str(e):
+            # remaining axes are not Auto under this shard_map's typing;
+            # the constraint is an optimization hint, never load-bearing
+            return x
+        raise  # genuine spec errors (rank mismatch etc.) must surface
 
 
 def data_sharding(mesh, *, extra_dims: int = 1):

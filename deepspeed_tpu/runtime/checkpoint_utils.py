@@ -29,3 +29,20 @@ def npz_dict_to_leaves(data):
             arr = arr.view(np.dtype(getattr(ml_dtypes, str(data[f"dtype_{i}"]))))
         leaves.append(arr)
     return leaves
+
+
+def named_leaf_entry(name, leaf):
+    """One name-keyed npz entry (+ dtype sidecar for ml_dtypes payloads)."""
+    arr = np.asarray(leaf)
+    return {name: arr, f"dtype::{name}": np.str_(str(arr.dtype))}
+
+
+def named_leaf_lookup(data, name):
+    """Inverse of named_leaf_entry against an open np.load handle."""
+    arr = data[name]
+    dkey = f"dtype::{name}"
+    if arr.dtype.kind == "V" and dkey in data.files:
+        import ml_dtypes
+
+        arr = arr.view(np.dtype(getattr(ml_dtypes, str(data[dkey]))))
+    return arr

@@ -362,6 +362,19 @@ class DeepSpeedEngine:
         if name == ONEBIT_ADAM_OPTIMIZER:
             from deepspeed_tpu.ops.onebit.onebit_adam import OnebitAdam
 
+            # wire compression (reference onebit_adam.py:104-228 compresses
+            # BEFORE the network): with data parallelism and no ZeRO/pipe
+            # sharding in the way, the train step runs under shard_map over
+            # 'data' so gradients stay device-local and the only gradient
+            # traffic after freeze_step is the bit-packed collective
+            dp = self.dp_world_size
+            wire_ok = (params.get("comm_backend_name", "xla") != "none"
+                       and dp > 1
+                       and self.zero_optimization_stage() == 0
+                       and self.mesh.shape.get("pipe", 1) == 1)
+            if wire_ok:
+                params.setdefault("axis_name", "data")
+                params.setdefault("axis_size", dp)
             return OnebitAdam(mesh=self.mesh, **params)
         if name == SGD_OPTIMIZER:
             from deepspeed_tpu.ops.adam.sgd import SGD
@@ -437,10 +450,17 @@ class DeepSpeedEngine:
         accum_sh = ns(zero_spec) if stage >= 2 else param_sh
 
         if self._offload:
-            # optimizer state lives on host; nothing to shard
+            # optimizer state lives on host, gradients stream to it per
+            # micro-batch — no device accumulator at all (1x params fp32 of
+            # HBM back; the 13B-per-chip headline depends on it). Micro-step
+            # grads come out ZeRO-sharded: out_shardings below makes XLA
+            # reduce-scatter instead of all-reduce, and each process then
+            # fetches only its own shard (reference stage2.py:876-958
+            # updates only the local partition).
+            self._offload_grad_sh = ns(zero_spec)
             self._shardings = TrainState(
                 step=rep, micro_step=rep, params=param_sh, opt_state=(),
-                master=None, accum=accum_sh,
+                master=None, accum=(),
                 scaler=(LossScaleState(rep, rep, rep, rep)
                         if self._use_loss_scaler() else None),
                 skipped_steps=rep, rng=rep)
@@ -521,11 +541,11 @@ class DeepSpeedEngine:
                 lambda l, sh: jax.device_put(
                     np.asarray(l, dtype=self.compute_dtype), sh),
                 host_master, param_sh)
-            accum_jit = jax.jit(
-                lambda p: jax.tree_util.tree_map(
-                    lambda l: jnp.zeros(l.shape, jnp.float32), p),
-                out_shardings=self._shardings.accum)
-            accum = accum_jit(params)
+        # host-side fp32 gradient accumulators (only this process's shard
+        # regions are ever written/read) + in-flight async fetches
+        self._host_grad_accum = None
+        self._pending_fetches = []
+        self._offload_regions_cache = None
 
         # scaler value lives in device state (the micro fn reads loss_scale
         # in jit); the update POLICY runs host-side via the shared
@@ -545,7 +565,7 @@ class DeepSpeedEngine:
 
         self.state = TrainState(
             step=jnp.int32(0), micro_step=jnp.int32(0), params=params,
-            opt_state=(), master=None, accum=accum, scaler=scaler,
+            opt_state=(), master=None, accum=(), scaler=scaler,
             skipped_steps=jnp.int32(0), rng=state_rng)
         n_params = sum(l.size for l in self._host_master_flat)
         log_dist(
@@ -687,6 +707,168 @@ class DeepSpeedEngine:
 
         return micro
 
+    def _make_micro_offload_fn(self):
+        """Offload micro step: no device accumulator — gradients are an
+        OUTPUT (fp32, ZeRO-sharded via out_shardings), streamed to the host
+        which owns accumulation + the Adam step."""
+        import jax
+        import jax.numpy as jnp
+
+        gas = self.gradient_accumulation_steps()
+        model = self.module
+
+        def micro(state: TrainState, batch):
+            rng = jax.random.fold_in(state.rng,
+                                     state.micro_step + state.step * 131071)
+
+            def loss_fn(params):
+                loss, metrics = model.loss(params, batch, rng, train=True)
+                scale = state.scaler.loss_scale if state.scaler is not None \
+                    else 1.0
+                return loss.astype(jnp.float32) * scale / gas, loss
+
+            grads, loss = jax.grad(loss_fn, has_aux=True)(state.params)
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads)
+            new_state = state._replace(micro_step=state.micro_step + 1)
+            return new_state, loss, grads
+
+        return micro
+
+    # ------------------------------------------------------------------
+    # offload host-side gradient streaming
+    # ------------------------------------------------------------------
+    def _offload_regions(self):
+        """Unique addressable (leaf_index, numpy_index, owned) regions of
+        the ZeRO grad sharding — the slices of each full-shape array this
+        process holds. `owned` is True on exactly ONE process per distinct
+        region (the lowest process index holding it): cross-process
+        reductions like the gradient norm must count a region once even
+        when a leaf stays replicated over 'data' (zero_merge_spec leaves
+        non-divisible leaves replicated). Cached; layouts are static."""
+        if self._offload_regions_cache is not None:
+            return self._offload_regions_cache
+        import jax
+
+        my_proc = jax.process_index()
+        regions = []
+        sh_flat = jax.tree_util.tree_leaves(self._offload_grad_sh)
+        for i, (master, sh) in enumerate(zip(self._host_master_flat,
+                                             sh_flat)):
+            imap = sh.devices_indices_map(tuple(master.shape))
+            owner = {}
+            for d, idx in imap.items():
+                key = tuple((s.start, s.stop, s.step) for s in idx)
+                owner[key] = min(owner.get(key, d.process_index),
+                                 d.process_index)
+            seen = set()
+            for d in sh.addressable_devices:
+                idx = imap[d]
+                key = tuple((s.start, s.stop, s.step) for s in idx)
+                if key in seen:
+                    continue
+                seen.add(key)
+                regions.append((i, idx, owner[key] == my_proc))
+        self._offload_regions_cache = regions
+        return regions
+
+    def _start_grad_fetch(self, grads):
+        """Kick off async D2H copies of this process's grad shards; returns
+        the leaves for later consumption. The copy overlaps the next
+        micro-batch's device compute (reference stage2.py:876-958 overlaps
+        D2H on a side stream the same way)."""
+        import jax
+
+        flat = jax.tree_util.tree_leaves(grads)
+        for leaf in flat:
+            for s in leaf.addressable_shards:
+                s.data.copy_to_host_async()
+        return flat
+
+    def _consume_grad_fetch(self, flat):
+        """Accumulate a fetched micro-batch's local grad shards into the
+        host fp32 buffers (allocated lazily, full-shape; only this
+        process's regions are ever touched)."""
+        if self._host_grad_accum is None:
+            self._host_grad_accum = [np.zeros(m.shape, np.float32)
+                                     for m in self._host_master_flat]
+        for buf, leaf in zip(self._host_grad_accum, flat):
+            seen = set()
+            for s in leaf.addressable_shards:
+                key = tuple((sl.start, sl.stop, sl.step) for sl in s.index)
+                if key in seen:
+                    continue
+                seen.add(key)
+                buf[s.index] += np.asarray(s.data, dtype=np.float32)
+
+    def _drain_pending_fetches(self):
+        for flat in self._pending_fetches:
+            self._consume_grad_fetch(flat)
+        self._pending_fetches = []
+
+    def _replicate_host_leaves(self, leaves):
+        """Fill non-local regions of full-shape host fp32 arrays from peer
+        processes: local regions go up ZeRO-sharded, one on-device gather
+        replicates, and the full array comes back down. Checkpoint-save
+        path only; leaves cycles through (master, m, v) so the grad-shard
+        layout tree is tiled over it."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh_flat = jax.tree_util.tree_leaves(self._offload_grad_sh)
+        rep = NamedSharding(self.mesh, P())
+        if not hasattr(self, "_jit_replicate"):
+            # one cached identity: jit retraces per shape, not per call
+            self._jit_replicate = jax.jit(lambda x: x, out_shardings=rep)
+        out = []
+        with jax.set_mesh(self.mesh):
+            for j, arr in enumerate(leaves):
+                gsh = sh_flat[j % len(sh_flat)]
+                imap = gsh.devices_indices_map(tuple(arr.shape))
+                arrs = [jax.device_put(
+                            np.ascontiguousarray(arr[imap[d]]), d)
+                        for d in gsh.addressable_devices]
+                ga = jax.make_array_from_single_device_arrays(
+                    tuple(arr.shape), gsh, arrs)
+                full = self._jit_replicate(ga)
+                out.append(np.asarray(jax.device_get(full),
+                                      dtype=np.float32))
+        return out
+
+    def _push_local_params(self):
+        """Upload this process's updated master slices in the compute dtype
+        and all-gather to the replicated/TP param layout on device — H2D
+        traffic is O(params/dp) per process, the gather rides ICI."""
+        import jax
+
+        dtype_name = str(jax.numpy.dtype(self.compute_dtype))
+        sh_flat = jax.tree_util.tree_leaves(self._offload_grad_sh)
+        param_sh_flat = jax.tree_util.tree_leaves(self._shardings.params)
+        sharded = []
+        for i, (master, gsh) in enumerate(zip(self._host_master_flat,
+                                              sh_flat)):
+            imap = gsh.devices_indices_map(tuple(master.shape))
+            pieces = {}
+            for d in gsh.addressable_devices:
+                idx = imap[d]
+                key = tuple((s.start, s.stop, s.step) for s in idx)
+                if key not in pieces:
+                    pieces[key] = self.optimizer.cast_to(
+                        [master[idx]], dtype_name)[0]
+            arrs = [jax.device_put(pieces[tuple(
+                        (s.start, s.stop, s.step) for s in imap[d])], d)
+                    for d in gsh.addressable_devices]
+            sharded.append(jax.make_array_from_single_device_arrays(
+                tuple(master.shape), gsh, arrs))
+        if self._jit_param_gather is None:
+            self._jit_param_gather = jax.jit(
+                lambda xs: xs, out_shardings=param_sh_flat)
+        with jax.set_mesh(self.mesh):
+            new_flat = self._jit_param_gather(sharded)
+        new_params = jax.tree_util.tree_unflatten(self._host_treedef,
+                                                  new_flat)
+        self.state = self.state._replace(params=new_params)
+
     def _make_apply_fn(self):
         import jax
         import jax.numpy as jnp
@@ -741,23 +923,239 @@ class DeepSpeedEngine:
 
         return apply
 
+    # ------------------------------------------------------------------
+    # 1-bit Adam wire-compressed path (shard_map over 'data')
+    # ------------------------------------------------------------------
+    def _onebit_wire(self) -> bool:
+        """True when the optimizer asked for on-the-wire gradient compression
+        (OnebitAdam with axis_name set): the fused step then runs under
+        shard_map with 'data' manual, so gradients stay device-local and the
+        only gradient-sized traffic after freeze_step is the bit-packed
+        collective (reference onebit_adam.py:104-228 compresses before the
+        network; the GSPMD path would psum densely first)."""
+        return (getattr(self.optimizer, "axis_name", None) is not None
+                and not self._offload)
+
+    def _onebit_frozen(self) -> bool:
+        """Static freeze phase for program selection, keyed on engine steps
+        (includes scale-skipped steps; warmup is thousands of steps so the
+        off-by-a-few vs the reference's optimizer-step count is immaterial)."""
+        return (self.global_steps + 1) > self.optimizer.freeze_step
+
+    def _make_onebit_tail(self, frozen):
+        """Shared optimizer tail for the wire path: overflow check ->
+        compressed/warmup update -> scaler. Runs inside shard_map with 'data'
+        manual. `accum` may be device-local (fused path) or replicated
+        (forward/backward/step path) — both are valid 1-bit inputs."""
+        import jax
+        import jax.numpy as jnp
+
+        optimizer = self.optimizer
+        mixed = self.mixed_precision
+        compute_dtype = self.compute_dtype
+        scaler_hp = self._scaler_hparams()
+
+        def tail(st, accum, lr):
+            scale = st.scaler.loss_scale if st.scaler is not None \
+                else jnp.float32(1.0)
+            bad = jnp.float32(0.0)
+            for g in jax.tree_util.tree_leaves(accum):
+                bad += jnp.sum((~jnp.isfinite(g)).astype(jnp.float32))
+            bad = jax.lax.psum(bad, "data")
+            overflow = bad > 0
+
+            def do_update(s2):
+                master = s2.master if mixed else s2.params
+                new_master, new_opt = optimizer.update(
+                    accum, s2.opt_state, master, lr=lr, scale=scale,
+                    frozen=frozen)
+                if mixed:
+                    new_params = jax.tree_util.tree_map(
+                        lambda l: l.astype(compute_dtype), new_master)
+                    return s2._replace(params=new_params, master=new_master,
+                                       opt_state=new_opt, step=s2.step + 1)
+                return s2._replace(params=new_master, opt_state=new_opt,
+                                   step=s2.step + 1)
+
+            def skip_update(s2):
+                return s2._replace(skipped_steps=s2.skipped_steps + 1,
+                                   step=s2.step + 1)
+
+            new_state = jax.lax.cond(overflow, skip_update, do_update, st)
+            if st.scaler is not None:
+                new_scaler = update_loss_scale(new_state.scaler, overflow,
+                                               **scaler_hp)
+                new_state = new_state._replace(scaler=new_scaler)
+            zero_accum = jax.tree_util.tree_map(jnp.zeros_like,
+                                                new_state.accum)
+            new_state = new_state._replace(accum=zero_accum,
+                                           micro_step=jnp.int32(0))
+            metrics = {"overflow": overflow,
+                       "grad_norm": jnp.float32(0.0),
+                       "loss_scale": scale}
+            return new_state, metrics
+
+        return tail
+
+    def _onebit_state_spec(self):
+        """State specs for the wire shard_map: partial-auto shard_map
+        in_specs may ONLY name manual axes ('data'); auto axes (TP 'model',
+        'pipe') are dropped — GSPMD keeps their placement implicitly."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def manual_only(axis):
+            if axis is None:
+                return None
+            axes = axis if isinstance(axis, tuple) else (axis,)
+            kept = tuple(a for a in axes if a == "data")
+            if not kept:
+                return None
+            return kept if len(kept) > 1 else kept[0]
+
+        return jax.tree_util.tree_map(
+            lambda s: P(*(manual_only(a) for a in s.spec)), self._shardings,
+            is_leaf=lambda x: isinstance(x, NamedSharding))
+
+    def _make_onebit_fused(self, frozen):
+        """Full train step (gas micro-batches + 1-bit update) with 'data'
+        manual: per-device gradients never see a dense collective."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        gas = self.gradient_accumulation_steps()
+        model = self.module
+        tail = self._make_onebit_tail(frozen)
+        state_spec = self._onebit_state_spec()
+
+        def fused(state, stacked_batch, lr):
+            batch_spec = jax.tree_util.tree_map(
+                lambda x: P(*([None, "data"] + [None] * (x.ndim - 2))),
+                stacked_batch)
+
+            def body(st, local_batch, lr):
+                scale = st.scaler.loss_scale if st.scaler is not None \
+                    else jnp.float32(1.0)
+
+                def micro(carry, b):
+                    accum, i = carry
+                    rng = jax.random.fold_in(
+                        st.rng, i + st.step * 131071)
+                    rng = jax.random.fold_in(
+                        rng, jax.lax.axis_index("data"))
+
+                    def loss_fn(params):
+                        loss, _ = model.loss(params, b, rng, train=True)
+                        return loss.astype(jnp.float32) * scale / gas, loss
+
+                    grads, loss = jax.grad(loss_fn, has_aux=True)(st.params)
+                    accum = jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(jnp.float32), accum, grads)
+                    return (accum, i + 1), loss
+
+                (accum, _), losses = jax.lax.scan(
+                    micro, (st.accum, st.micro_step), local_batch)
+                new_state, metrics = tail(st, accum, lr)
+                metrics["loss"] = jax.lax.pmean(losses.mean(), "data")
+                return new_state, metrics
+
+            metrics_spec = {"overflow": P(), "grad_norm": P(),
+                            "loss_scale": P(), "loss": P()}
+            return jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(state_spec, batch_spec, P()),
+                out_specs=(state_spec, metrics_spec),
+                axis_names={"data"}, check_vma=False)(state, stacked_batch, lr)
+
+        return fused
+
+    def _make_onebit_apply(self, frozen):
+        """Optimizer step for the forward/backward/step path: accum arrived
+        mesh-averaged from the GSPMD micro steps (identical per device), so
+        the update still runs under shard_map for the bit-packed collective."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        mesh = self.mesh
+        tail = self._make_onebit_tail(frozen)
+        state_spec = self._onebit_state_spec()
+
+        def apply_(state, lr):
+            metrics_spec = {"overflow": P(), "grad_norm": P(),
+                            "loss_scale": P()}
+            return jax.shard_map(
+                lambda st, lr: tail(st, st.accum, lr), mesh=mesh,
+                in_specs=(state_spec, P()),
+                out_specs=(state_spec, metrics_spec),
+                axis_names={"data"}, check_vma=False)(state, lr)
+
+        return apply_
+
+    def _compile_onebit(self):
+        import jax
+
+        sh = self._shardings
+        if self.gradient_clipping():
+            log_dist("1-bit Adam wire path ignores gradient_clipping "
+                     "(reference onebit_adam.py has no global-norm clip)",
+                     ranks=[0])
+        self._jit_micro = jax.jit(self._make_micro_fn(),
+                                  out_shardings=(sh, None))
+        self._onebit_fused_fns = {b: self._make_onebit_fused(b)
+                                  for b in (False, True)}
+        self._onebit_apply_fns = {b: self._make_onebit_apply(b)
+                                  for b in (False, True)}
+        self._onebit_fused_jits = {}
+        self._onebit_apply_jits = {}
+
+    def _fused_callable(self):
+        if getattr(self, "_onebit_fused_fns", None):
+            import jax
+
+            frozen = self._onebit_frozen()
+            if frozen not in self._onebit_fused_jits:
+                self._onebit_fused_jits[frozen] = jax.jit(
+                    self._onebit_fused_fns[frozen], donate_argnums=(0,),
+                    out_shardings=(self._shardings, None))
+            return self._onebit_fused_jits[frozen]
+        return self._jit_fused
+
+    def _apply_callable(self):
+        if getattr(self, "_onebit_apply_fns", None):
+            import jax
+
+            frozen = self._onebit_frozen()
+            if frozen not in self._onebit_apply_jits:
+                self._onebit_apply_jits[frozen] = jax.jit(
+                    self._onebit_apply_fns[frozen], donate_argnums=(0,),
+                    out_shardings=(self._shardings, None))
+            return self._onebit_apply_jits[frozen]
+        return self._jit_apply
+
     def _compile(self):
         if self._jit_micro is not None:
             return
         import jax
 
-        sh = self._shardings
-        micro = self._make_micro_fn()
-        if self._offload:
-            # apply runs on host (CPU Adam); only the micro step is jitted
-            self._jit_micro = jax.jit(micro, out_shardings=(sh, None))
-            import jax.numpy as jnp
-
-            # zeros_like, not a*0: accum may hold Inf/NaN after an overflow
-            self._jit_zero_accum = jax.jit(
-                lambda a: jax.tree_util.tree_map(jnp.zeros_like, a),
-                donate_argnums=(0,), out_shardings=sh.accum)
+        if self._onebit_wire():
+            self._compile_onebit()
             return
+
+        sh = self._shardings
+        if self._offload:
+            # apply runs on host (CPU Adam); the jitted micro step returns
+            # this micro-batch's gradients reduce-SCATTERED over 'data'
+            # (out_shardings = zero spec) so each process fetches only its
+            # own shard; accumulation happens host-side, overlapped with the
+            # next micro-batch's device compute
+            self._jit_micro = jax.jit(
+                self._make_micro_offload_fn(),
+                out_shardings=(sh, None, self._offload_grad_sh))
+            self._jit_param_gather = None  # built on first step
+            return
+        micro = self._make_micro_fn()
         apply_ = self._make_apply_fn()
 
         # NOTE: the micro step does NOT donate its input state — backward()
@@ -802,7 +1200,8 @@ class DeepSpeedEngine:
 
         prof = FlopsProfiler(engine=self)
         prof.profile_params(self.state.params)
-        micro = self._make_micro_fn()
+        micro = self._make_micro_offload_fn() if self._offload \
+            else self._make_micro_fn()
         import jax
 
         with jax.set_mesh(self.mesh):
@@ -843,7 +1242,12 @@ class DeepSpeedEngine:
         import jax
 
         with jax.set_mesh(self.mesh):
-            new_state, loss = self._jit_micro(self.state, dev_batch)
+            if self._offload:
+                new_state, loss, grads = self._jit_micro(self.state,
+                                                         dev_batch)
+                self._pending_grads = grads
+            else:
+                new_state, loss = self._jit_micro(self.state, dev_batch)
         # torch-parity semantics: gradients only land when backward() commits
         # the staged state; a forward without backward contributes nothing.
         self._pending_state = new_state
@@ -867,6 +1271,16 @@ class DeepSpeedEngine:
             "backward() called without a preceding forward()"
         self.state = self._pending_state
         self._pending_state = None
+        if self._offload:
+            # kick off the async D2H of this micro's local grad shards, then
+            # consume the PREVIOUS micro's (its copy overlapped this one's
+            # compute). Keeping at most one fetch in flight bounds device
+            # memory to one grad tree — gas in-flight trees would cost more
+            # HBM than the accumulator this path removed.
+            fetch = self._start_grad_fetch(self._pending_grads)
+            self._pending_grads = None
+            self._drain_pending_fetches()
+            self._pending_fetches.append(fetch)
         self.micro_steps += 1
         if self.wall_clock_breakdown():
             self.timers(BACKWARD_MICRO_TIMER).stop()
@@ -887,53 +1301,66 @@ class DeepSpeedEngine:
             self.timers(STEP_MICRO_TIMER).stop()
 
     def _take_model_step_offload(self):
-        """Host-driven step: grads -> host, AVX Adam on the fp32 master,
-        compute-dtype params -> device (reference stage2.py:1525-1536)."""
+        """Host-driven step, shard-local: each process updates ONLY the
+        master/moment regions backing its own ZeRO grad shards (reference
+        stage2.py:876-958,1525-1536), then pushes just those slices back —
+        the replicated params materialize via one on-device all-gather over
+        ICI instead of a full H2D upload per process."""
         import jax
 
         lr = self._advance_lr()
         state = self.state
-        accum = state.accum
-        if jax.process_count() > 1:
-            # cross-host ZeRO shards are not addressable from this process;
-            # reshard to replicated before the host fetch (same pattern as
-            # save_checkpoint; per-shard host update is the planned
-            # optimization)
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            rep = NamedSharding(self.mesh, P())
-            rep_tree = jax.tree_util.tree_map(lambda _: rep, accum)
-            with jax.set_mesh(self.mesh):
-                accum = jax.jit(lambda a: a, out_shardings=rep_tree)(accum)
-        grads_flat = [np.asarray(jax.device_get(g), dtype=np.float32)
-                      for g in jax.tree_util.tree_leaves(accum)]
+        self._drain_pending_fetches()
+        if self._host_grad_accum is None:  # zero micro-batches ran
+            self._host_grad_accum = [np.zeros(m.shape, np.float32)
+                                     for m in self._host_master_flat]
+        regions = self._offload_regions()
         scale = self._host_scaler.cur_scale \
             if self._host_scaler is not None else 1.0
-        finite = all(np.isfinite(g).all() for g in grads_flat)
+        finite = all(
+            np.isfinite(self._host_grad_accum[i][idx]).all()
+            for i, idx, _ in regions)
+        clip = self.gradient_clipping()
+        # norm counts only owned regions: a leaf replicated over 'data'
+        # appears on every process and must not be summed N_proc times
+        local_sq = sum(
+            float((self._host_grad_accum[i][idx].astype(np.float64) ** 2)
+                  .sum()) for i, idx, owned in regions if owned) \
+            if (clip or finite) else 0.0
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            stats = multihost_utils.process_allgather(
+                np.asarray([local_sq, 0.0 if finite else 1.0]))
+            total_sq = float(stats[:, 0].sum())
+            finite = float(stats[:, 1].sum()) == 0.0
+        else:
+            total_sq = local_sq
 
         if finite:
-            clip = self.gradient_clipping()
-            gnorm = float(np.sqrt(sum(float((g.astype(np.float64) ** 2).sum())
-                                      for g in grads_flat))) / scale
+            gnorm = float(np.sqrt(total_sq)) / scale
             clip_factor = min(1.0, clip / (gnorm + 1e-6)) if clip else 1.0
+            masters = [self._host_master_flat[i][idx]
+                       for i, idx, _ in regions]
+            grads = [self._host_grad_accum[i][idx] for i, idx, _ in regions]
+            ms = [self._host_opt["m"][i][idx] for i, idx, _ in regions]
+            vs = [self._host_opt["v"][i][idx] for i, idx, _ in regions]
+            # region lists are VIEWS into the full host arrays: the kernel
+            # updates them in place. The temp state dict's step increment is
+            # discarded; the persistent counter advances once below.
             # ds_adam_step divides grads by grad_scale: fold unscale + clip
-            self._host_opt = self.optimizer.step(
-                self._host_master_flat, grads_flat, self._host_opt, lr=lr,
-                grad_scale=scale / clip_factor)
-            # cast on host via the C++ converter, then one transfer
-            host_params = self.optimizer.cast_to(
-                self._host_master_flat, str(jax.numpy.dtype(self.compute_dtype)))
-            params_tree = jax.tree_util.tree_unflatten(
-                self._host_treedef, host_params)
-            with jax.set_mesh(self.mesh):
-                new_params = jax.tree_util.tree_map(
-                    lambda l, sh: jax.device_put(l, sh), params_tree,
-                    self._shardings.params)
-            self.state = state._replace(params=new_params)
+            self.optimizer.step(
+                masters, grads, {"step": self._host_opt["step"],
+                                 "m": ms, "v": vs},
+                lr=lr, grad_scale=scale / clip_factor)
+            self._host_opt["step"] += 1
+            self._push_local_params()
             self._last_grad_norm = gnorm
         else:
             self._host_skipped += 1
             self._last_grad_norm = 0.0
+        for i, idx, _ in regions:
+            self._host_grad_accum[i][idx] = 0.0
         new_scale = scale
         if self._host_scaler is not None:
             self._host_scaler.update_scale(not finite)
@@ -945,13 +1372,11 @@ class DeepSpeedEngine:
 
         import jax.numpy as jnp
 
-        with jax.set_mesh(self.mesh):
-            zero_accum = self._jit_zero_accum(self.state.accum)
         scaler = self.state.scaler
         if scaler is not None and new_scale != scale:
             scaler = make_loss_scale_state(new_scale)
         self.state = self.state._replace(
-            accum=zero_accum, micro_step=jnp.int32(0),
+            micro_step=jnp.int32(0),
             step=self.state.step + 1, scaler=scaler)
         self.global_steps += 1
         if self.progressive_layer_drop is not None:
@@ -970,7 +1395,8 @@ class DeepSpeedEngine:
         import jax.numpy as jnp
 
         with jax.set_mesh(self.mesh):
-            new_state, metrics = self._jit_apply(self.state, jnp.float32(lr))
+            new_state, metrics = self._apply_callable()(
+                self.state, jnp.float32(lr))
         self.state = new_state
         self.global_steps += 1
         if self.progressive_layer_drop is not None:
@@ -1016,15 +1442,27 @@ class DeepSpeedEngine:
         import jax.numpy as jnp
 
         if self._offload:
-            # apply runs on host: micro-loop on device, then the CPU step
+            # apply runs on host: micro-loop on device; each micro's grad
+            # shards D2H-copy asynchronously while the NEXT micro computes
+            # (host-side accumulation of micro i overlaps device compute of
+            # micro i+1 — the reference's migration-stream overlap,
+            # stage2.py:876-958)
             self._maybe_profile(self._shard_batch(_first_micro(batch)))
             self.tput_timer.start()
             losses = []
+            prev_fetch = None
             with jax.set_mesh(self.mesh):
                 for i in range(gas):
                     dev_micro = self._shard_batch(_micro_at(batch, i))
-                    self.state, loss = self._jit_micro(self.state, dev_micro)
+                    self.state, loss, grads = self._jit_micro(self.state,
+                                                              dev_micro)
+                    fetch = self._start_grad_fetch(grads)
                     losses.append(loss)
+                    if prev_fetch is not None:
+                        self._consume_grad_fetch(prev_fetch)
+                    prev_fetch = fetch
+            if prev_fetch is not None:
+                self._consume_grad_fetch(prev_fetch)
             self.micro_steps += gas
             self._take_model_step_offload()  # reports progress itself
             self.tput_timer.stop()
@@ -1036,7 +1474,8 @@ class DeepSpeedEngine:
 
         self.tput_timer.start()
         with jax.set_mesh(self.mesh):
-            new_state, metrics = self._jit_fused(self.state, dev, jnp.float32(lr))
+            new_state, metrics = self._fused_callable()(
+                self.state, dev, jnp.float32(lr))
         self.state = new_state
         self.global_steps += 1
         if self.progressive_layer_drop is not None:
@@ -1165,15 +1604,22 @@ class DeepSpeedEngine:
             flat, _ = jax.tree_util.tree_flatten(host_state)
             np.savez(os.path.join(path, "model_states.npz"),
                      **leaves_to_npz_dict(flat))
+        off_leaves = None
+        if self._offload:
+            # shard-local stepping means each process's host arrays are only
+            # authoritative on its own regions: reassemble full arrays via a
+            # device round-trip before rank 0 writes them (save-time only)
+            off_leaves = (self._host_master_flat + self._host_opt["m"]
+                          + self._host_opt["v"])
+            if jax.process_count() > 1:
+                off_leaves = self._replicate_host_leaves(off_leaves)
         if jax.process_index() == 0:
             if self._offload:
                 from deepspeed_tpu.runtime.checkpoint_utils import \
                     leaves_to_npz_dict
 
                 np.savez(os.path.join(path, "offload_states.npz"),
-                         **leaves_to_npz_dict(
-                             self._host_master_flat + self._host_opt["m"]
-                             + self._host_opt["v"]),
+                         **leaves_to_npz_dict(off_leaves),
                          opt_step=self._host_opt["step"])
             meta = {
                 "global_steps": self.global_steps,

@@ -627,6 +627,29 @@ class PipelineEngine(DeepSpeedEngine):
     # ------------------------------------------------------------------
     # checkpointing (pipeline layout: per-stage state files)
     # ------------------------------------------------------------------
+    def _layer_key_set(self):
+        """Stage-count-independent universe of layer param keys: layer-
+        granular files are keyed by these, so a checkpoint written at pp=N
+        can be read at pp=M (reference pipe/module.py:536-567 writes
+        layer_XX-model_states files for the same reason)."""
+        return {layer.param_key for layer in self.module._layers
+                if layer.param_key is not None}
+
+    @staticmethod
+    def _path_layer_key(path, layer_keys):
+        import jax
+
+        for p in path:
+            if isinstance(p, jax.tree_util.DictKey) and str(p.key) in layer_keys:
+                return str(p.key)
+        return None
+
+    def _stage_save_tree(self, st):
+        """The persisted slice of a StageState. accum is excluded: steps only
+        complete at accumulation boundaries, where it is zeros."""
+        return {"params": st.params, "master": st.master,
+                "opt_state": st.opt_state}
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
         import jax
@@ -637,13 +660,28 @@ class PipelineEngine(DeepSpeedEngine):
             tag = f"global_step{self.global_steps}"
         path = os.path.join(save_dir, str(tag))
         os.makedirs(path, exist_ok=True)
-        from deepspeed_tpu.runtime.checkpoint_utils import leaves_to_npz_dict
 
-        for s, st in enumerate(self.stage_states):
-            host = jax.device_get(st)
-            flat, _ = jax.tree_util.tree_flatten(host)
-            np.savez(os.path.join(path, f"stage_{s:02d}_states.npz"),
-                     **leaves_to_npz_dict(flat))
+        # layer-granular layout: one file per layer param key, entries keyed
+        # by the leaf's tree path (identical no matter which stage owns the
+        # layer), plus a 'globals' file for layer-independent optimizer
+        # scalars (identical on every stage)
+        from deepspeed_tpu.runtime.checkpoint_utils import named_leaf_entry
+
+        layer_keys = self._layer_key_set()
+        per_layer = {}
+        global_leaves = {}
+        for st in self.stage_states:
+            host = jax.device_get(self._stage_save_tree(st))
+            for p, leaf in jax.tree_util.tree_flatten_with_path(host)[0]:
+                entry = named_leaf_entry(jax.tree_util.keystr(p), leaf)
+                k = self._path_layer_key(p, layer_keys)
+                if k is None:
+                    global_leaves.update(entry)
+                else:
+                    per_layer.setdefault(k, {}).update(entry)
+        for k, entries in per_layer.items():
+            np.savez(os.path.join(path, f"{k}-states.npz"), **entries)
+        np.savez(os.path.join(path, "globals-states.npz"), **global_leaves)
         meta = {
             "global_steps": self.global_steps,
             "micro_steps": self.micro_steps,
@@ -652,6 +690,8 @@ class PipelineEngine(DeepSpeedEngine):
             "scaler_state": self._pipe_scaler.__dict__.copy(),
             "num_stages": self.num_stages,
             "partition": self.module.partition_layers(self.num_stages),
+            "layer_keys": sorted(layer_keys),
+            "format": "layer-granular",
             "lr_scheduler": self.lr_scheduler.state_dict()
             if self.lr_scheduler is not None else None,
             "client_state": client_state,
@@ -661,7 +701,8 @@ class PipelineEngine(DeepSpeedEngine):
         if save_latest:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(str(tag))
-        log_dist(f"Saved pipeline checkpoint {path}", ranks=[0])
+        log_dist(f"Saved pipeline checkpoint {path} "
+                 f"({len(per_layer)} layer files)", ranks=[0])
         return True
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
@@ -679,23 +720,45 @@ class PipelineEngine(DeepSpeedEngine):
         path = os.path.join(load_dir, str(tag))
         with open(os.path.join(path, "metadata.pkl"), "rb") as f:
             meta = pickle.load(f)
-        assert meta["num_stages"] == self.num_stages, \
-            (f"checkpoint has {meta['num_stages']} stages, engine has "
-             f"{self.num_stages}; repartitioning across stage counts needs "
-             f"layer-granular save (planned)")
+        assert meta.get("format") == "layer-granular", \
+            "pre-round-4 per-stage pipeline checkpoints are not readable; " \
+            "re-save with this version"
         assert self.stage_states is not None, \
             "run one batch (or _ensure_pipe_state) before load_checkpoint"
-        from deepspeed_tpu.runtime.checkpoint_utils import npz_dict_to_leaves
+        layer_keys = self._layer_key_set()
+        saved_keys = set(meta.get("layer_keys", []))
+        if load_module_strict:
+            assert saved_keys == layer_keys, \
+                (f"checkpoint layers {sorted(saved_keys)} != module layers "
+                 f"{sorted(layer_keys)}")
 
+        from deepspeed_tpu.runtime.checkpoint_utils import named_leaf_lookup
+
+        files = {}
+
+        def lookup(k, name):
+            fname = "globals-states.npz" if k is None else f"{k}-states.npz"
+            if fname not in files:
+                files[fname] = np.load(os.path.join(path, fname))
+            return named_leaf_lookup(files[fname], name)
+
+        # rebuild each (possibly re-partitioned) stage from the layer files:
+        # every leaf of the fresh stage state is looked up by (layer key,
+        # tree path), which is stage-layout independent
         new_states = []
-        for s, st in enumerate(self.stage_states):
-            data = np.load(os.path.join(path, f"stage_{s:02d}_states.npz"))
-            flat = npz_dict_to_leaves(data)
-            treedef = jax.tree_util.tree_structure(jax.device_get(st))
-            host = jax.tree_util.tree_unflatten(treedef, flat)
+        for st in self.stage_states:
+            tpl = jax.device_get(self._stage_save_tree(st))
+            leaves, treedef = jax.tree_util.tree_flatten_with_path(tpl)
+            restored = [lookup(self._path_layer_key(p, layer_keys),
+                               jax.tree_util.keystr(p))
+                        for p, _ in leaves]
+            host = jax.tree_util.tree_unflatten(treedef, restored)
+            ref = self._stage_save_tree(st)
             dev = jax.tree_util.tree_map(
-                lambda l, ref: jax.device_put(l, ref.sharding), host, st)
-            new_states.append(dev)
+                lambda l, r: jax.device_put(l, r.sharding), host, ref)
+            new_states.append(st._replace(
+                params=dev["params"], master=dev["master"],
+                opt_state=dev["opt_state"]))
         self.stage_states = new_states
         self.global_steps = meta["global_steps"]
         self.micro_steps = meta["micro_steps"]

@@ -208,3 +208,34 @@ def test_engine_offload_fp16_overflow_skips():
     assert engine.skipped_steps == 2
     scale1 = float(jax.device_get(engine.state.scaler.loss_scale))
     assert scale1 <= scale0 / 2
+
+
+def test_engine_offload_gas_accumulation_matches():
+    """gas=4: per-micro gradients stream to host asynchronously and
+    accumulate there (no device accumulator at all — state.accum is empty);
+    the trajectory must match the on-device engine."""
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel, random_dataloader
+
+    def run(offload):
+        model = SimpleModel(hidden_dim=16)
+        cfg = {
+            "train_batch_size": 64,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+            "zero_optimization": {"stage": 2, "cpu_offload": offload},
+            "steps_per_print": 100,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                                   config_params=cfg)
+        data = random_dataloader(16, 256, 16, seed=0)
+        losses = [float(jax.device_get(engine.train_batch(data_iter=data)))
+                  for _ in range(4)]
+        return engine, losses
+
+    _, base = run(offload=False)
+    engine, off = run(offload=True)
+    assert engine.state.accum == ()  # the freed device accumulator
+    assert np.isfinite(off).all() and off[-1] < off[0]
+    np.testing.assert_allclose(base, off, rtol=2e-3, atol=1e-4)

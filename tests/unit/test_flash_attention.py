@@ -72,3 +72,61 @@ def test_flash_multiblock_causal_grad():
     for a, b in zip(g_fl, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=5e-6, rtol=5e-4)
+
+
+@pytest.mark.parametrize("kind", ["key", "full"])
+def test_flash_bias_matches_reference(kind):
+    """Additive bias (HF extended mask / full scores bias) in-kernel must
+    match the jnp reference path, forward and q/k/v gradients."""
+    from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
+    from deepspeed_tpu.ops.transformer.functional import (
+        scaled_dot_product_attention)
+
+    rng = np.random.default_rng(3)
+    B, H, S, D = 2, 3, 256, 64
+    q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    if kind == "key":
+        # key-padding: mask out the tail keys of each batch row
+        bias = np.zeros((B, 1, 1, S), np.float32)
+        bias[0, ..., 200:] = -1e9
+        bias[1, ..., 100:] = -1e9
+    else:
+        bias = rng.standard_normal((B, H, S, S)).astype(np.float32)
+    bias = jnp.asarray(bias)
+
+    ref = scaled_dot_product_attention(q, k, v, bias=bias, use_pallas=False)
+    got = flash_attention(q, k, v, bias=bias, interpret=True,
+                          block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
+
+    def loss_ref(q, k, v):
+        return scaled_dot_product_attention(
+            q, k, v, bias=bias, use_pallas=False).sum()
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, bias=bias, interpret=True,
+                               block_q=128, block_k=128).sum()
+
+    gr = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_flash, (0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-2)
+
+
+def test_flash_bias_constant_no_grad():
+    """The kernel treats bias as constant: its cotangent is zero (a learned
+    bias must use the jnp path — functional._pallas_attention_ok guards the
+    auto-dispatch accordingly)."""
+    from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
+
+    rng = np.random.default_rng(0)
+    B, H, S, D = 1, 2, 128, 64
+    q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    bias = jnp.asarray(rng.standard_normal((B, 1, 1, S)), jnp.float32)
+    g = jax.grad(lambda b: flash_attention(
+        q, q, q, bias=b, interpret=True).sum())(bias)
+    np.testing.assert_array_equal(np.asarray(g), 0.0)

@@ -174,3 +174,147 @@ def test_engine_with_onebit_adam():
         engine.step()
         losses.append(float(jax.device_get(loss)))
     assert np.isfinite(losses).all()
+
+
+# ---------------------------------------------------------------------------
+# engine wire-compression path (round-4: compress BEFORE the network)
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_RE = None
+
+
+def _collective_bytes(hlo_text):
+    """Sum output bytes of gradient-moving collectives in compiled HLO."""
+    import re
+
+    sizes = {"f32": 4, "bf16": 2, "f16": 2, "u8": 1, "s8": 1, "u32": 4,
+             "s32": 4, "f64": 8, "pred": 1}
+    total = 0
+    per_op = []
+    for line in hlo_text.splitlines():
+        # output may be a scalar shape or a tuple: `%x = (f32[64], u8[8]) op(...)`;
+        # `%...` before the op name means a get-tuple-element reference, not
+        # the collective itself
+        m = re.search(r"=\s*(\(?[^()=]*\)?)\s*"
+                      r"(all-reduce|all-to-all|all-gather|collective-permute)"
+                      r"(-start)?(\.\d+)?\(", line)
+        if not m or line.lstrip().startswith("ROOT %get") \
+                or "get-tuple-element(" in line:
+            continue
+        op = m.group(2)
+        for dtype, dims in re.findall(r"(\w+)\[([\d,]*)\]", m.group(1)):
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            b = n * sizes.get(dtype, 4)
+            total += b
+            per_op.append((op, dtype, n, b))
+    return total, per_op
+
+
+def _wire_engine(freeze_step=3, hidden=64):
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel
+
+    model = SimpleModel(hidden_dim=hidden)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config_params={
+        "train_batch_size": 16, "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "OneBitAdam",
+                      "params": {"lr": 1e-2, "freeze_step": freeze_step}},
+        "mesh": {"data": 8}, "steps_per_print": 10 ** 9})
+    return engine
+
+
+def test_onebit_wire_enabled_by_engine(eight_devices):
+    engine = _wire_engine()
+    assert engine.optimizer.axis_name == "data"
+    assert engine.optimizer.axis_size == 8
+    assert engine._onebit_wire()
+
+
+def test_onebit_wire_saves_gradient_bytes(eight_devices):
+    """The post-freeze fused program must move ~1/32 the gradient bytes of
+    the warmup program: warmup all-reduces fp32 gradients; post-freeze the
+    only gradient-sized traffic is the bit-packed u8 sign collective
+    (reference onebit_adam.py:104-228 + docs 5x comm-volume claim)."""
+    import jax
+    import jax.numpy as jnp
+
+    engine = _wire_engine()
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.standard_normal((1, 16, 64)).astype(np.float32),
+             "y": rng.integers(0, 4, (1, 16)).astype(np.int32)}
+    engine._ensure_state({k: v[0] for k, v in batch.items()})
+    engine._compile()
+    dev = engine._shard_stacked_batch(batch)
+
+    texts = {}
+    with jax.set_mesh(engine.mesh):
+        for frozen in (False, True):
+            fn = engine._onebit_fused_fns[frozen]
+            lowered = jax.jit(fn).lower(engine.state, dev, jnp.float32(1e-2))
+            texts[frozen] = lowered.compile().as_text()
+    warm_bytes, warm_ops = _collective_bytes(texts[False])
+    frozen_bytes, frozen_ops = _collective_bytes(texts[True])
+
+    n_params = sum(int(l.size) for l in
+                   jax.tree_util.tree_leaves(engine.state.params))
+    # warmup must carry a dense fp32 gradient all-reduce
+    assert warm_bytes >= 4 * n_params, (warm_bytes, n_params, warm_ops)
+    # post-freeze: no f32 gradient-sized collective at all, and way less
+    # total traffic (u8 signs + fp32 scales + scalar overflow/loss syncs)
+    big_f32 = [o for o in frozen_ops
+               if o[1] in ("f32", "bf16") and o[2] >= n_params]
+    assert not big_f32, f"dense gradient collective after freeze: {big_f32}"
+    assert frozen_bytes * 8 <= warm_bytes, (
+        f"frozen step moves {frozen_bytes}B vs warmup {warm_bytes}B — "
+        f"expected >=8x reduction; frozen ops: {frozen_ops}")
+
+
+def test_onebit_wire_trains_through_freeze(eight_devices):
+    engine = _wire_engine(freeze_step=3)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, 16, 64)).astype(np.float32)
+    y = rng.integers(0, 4, (1, 16)).astype(np.int32)
+    losses = [float(jax.device_get(
+        engine.train_batch(batch={"x": x, "y": y}))) for _ in range(10)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, losses
+    # error feedback is live after freeze and per-device
+    we = jax.tree_util.tree_leaves(engine.state.opt_state.worker_error)[0]
+    assert we.shape[0] == 8
+    assert str(we.sharding.spec).startswith("PartitionSpec('data'")
+    assert np.abs(np.asarray(jax.device_get(we))).sum() > 0
+
+
+@pytest.mark.parametrize("mesh", [{"data": 8}, {"data": 4, "model": 2}])
+def test_onebit_wire_gpt2_with_sharding_constraints(eight_devices, mesh):
+    """Regression: GPT-2 annotates activations with mesh_lib.constrain over
+    'data' (gpt2.py Block); under the wire path's shard_map that axis is
+    manual and with_sharding_constraint rejects it — constrain must drop
+    manual axes instead of crashing. The dp x tp case additionally runs TP
+    param shardings ('model' stays an auto axis) through the shard_map."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Model, gpt2_config
+
+    cfg = gpt2_config("gpt2-125m", n_positions=64, n_layer=2, n_embd=32,
+                      n_head=2, vocab_size=128, dtype=jnp.float32,
+                      loss_chunk_tokens=0)
+    model = GPT2Model(cfg)
+    dp = mesh["data"]
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config_params={
+        "train_batch_size": dp, "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "OneBitAdam",
+                      "params": {"lr": 1e-3, "freeze_step": 2}},
+        "mesh": dict(mesh, allow_partial=True), "steps_per_print": 10 ** 9})
+    assert engine.optimizer.axis_name == "data"
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, (1, dp, 64))
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    losses = [float(jax.device_get(engine.train_batch(batch=batch)))
+              for _ in range(4)]  # crosses freeze_step=2
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

@@ -1,0 +1,76 @@
+"""Amortized flash-attention timing: N chained calls inside ONE jit, so the
+tunnel's per-dispatch overhead (~3ms) doesn't swamp the kernel time."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
+from deepspeed_tpu.ops.transformer.functional import (
+    scaled_dot_product_attention)
+
+BS = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+H = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+SEQ = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+D = int(sys.argv[4]) if len(sys.argv) > 4 else 64
+CHAIN = 8
+ITERS = 10
+
+
+def bench_chain(name, att_fn, q, k, v, flops_per_call, grad=False):
+    def chained(q, k, v):
+        y = q
+        for i in range(CHAIN):
+            y = att_fn(y, k, v)
+        return y
+
+    if grad:
+        f = jax.jit(jax.grad(
+            lambda q, k, v: chained(q, k, v).astype(jnp.float32).sum()))
+        per_call = 3.5 * flops_per_call
+    else:
+        f = jax.jit(chained)
+        per_call = flops_per_call
+    o = f(q, k, v)
+    jax.block_until_ready(o)
+    jax.device_get(jax.tree_util.tree_leaves(o)[0].ravel()[0])
+    t0 = time.time()
+    for _ in range(ITERS):
+        o = f(q, k, v)
+    jax.device_get(jax.tree_util.tree_leaves(o)[0].ravel()[0])
+    dt = (time.time() - t0) / ITERS / CHAIN
+    print(f"{name:34s} {dt*1000:7.2f} ms/call {per_call/dt/1e12:6.1f} TF",
+          flush=True)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((BS, H, SEQ, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((BS, H, SEQ, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((BS, H, SEQ, D)), jnp.bfloat16)
+    att_flops = 4.0 * BS * H * SEQ * SEQ * D
+
+    bench_chain("jnp fwd", lambda q, k, v: scaled_dot_product_attention(
+        q, k, v, causal=True, use_pallas=False), q, k, v, att_flops)
+    bench_chain("jnp fwd+bwd", lambda q, k, v: scaled_dot_product_attention(
+        q, k, v, causal=True, use_pallas=False), q, k, v, att_flops, grad=True)
+    for bq, bk in [(256, 512), (512, 512), (512, 1024), (256, 1024)]:
+        if bq > SEQ or bk > SEQ:
+            continue
+        bench_chain(f"pallas bq={bq} bk={bk} fwd",
+                    lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                        q, k, v, causal=True, block_q=bq, block_k=bk),
+                    q, k, v, att_flops)
+        bench_chain(f"pallas bq={bq} bk={bk} fwd+bwd",
+                    lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                        q, k, v, causal=True, block_q=bq, block_k=bk),
+                    q, k, v, att_flops, grad=True)
+
+
+if __name__ == "__main__":
+    main()

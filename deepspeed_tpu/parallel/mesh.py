@@ -116,10 +116,11 @@ def constrain(x, spec):
         return x
     try:
         return jax.lax.with_sharding_constraint(x, cleaned)
-    except ValueError as e:
-        if "Auto axes" in str(e):
-            # remaining axes are not Auto under this shard_map's typing;
-            # the constraint is an optimization hint, never load-bearing
+    except ValueError:
+        if manual:
+            # inside a shard_map: remaining axes may not be Auto under its
+            # typing — the constraint is an optimization hint, never
+            # load-bearing, so dropping it there is always safe
             return x
         raise  # genuine spec errors (rank mismatch etc.) must surface
 

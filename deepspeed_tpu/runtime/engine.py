@@ -457,7 +457,32 @@ class DeepSpeedEngine:
             # reduce-scatter instead of all-reduce, and each process then
             # fetches only its own shard (reference stage2.py:876-958
             # updates only the local partition).
-            self._offload_grad_sh = ns(zero_spec)
+            # sparse_gradients (reference engine.py:187-193,1227-1265):
+            # models may declare untied embedding tables whose gradients are
+            # row-sparse; those leaves stream to the host as (row indices,
+            # row values) with capacity = batch tokens instead of the dense
+            # table, cutting offload D2H traffic by ~vocab/tokens. The flag
+            # tree is static (model contract); row capacity binds per trace.
+            self._offload_sparse_flags = None
+            if self.sparse_gradients_enabled() \
+                    and hasattr(self.module, "sparse_grad_spec"):
+                self._offload_sparse_flags = \
+                    self.module.sparse_grad_spec(params_template)
+            zero_ns = ns(zero_spec)
+            if self._offload_sparse_flags is not None:
+                # grads out_shardings: sparse leaves become replicated
+                # {indices, values} pairs; region layout (for the host
+                # master/moment step) treats them as whole-buffer regions
+                self._offload_grad_sh = jax.tree_util.tree_map(
+                    lambda flag, s: {"csr_indices": rep, "csr_values": rep}
+                    if flag else s,
+                    self._offload_sparse_flags, zero_ns)
+                self._offload_region_sh = jax.tree_util.tree_map(
+                    lambda flag, s: rep if flag else s,
+                    self._offload_sparse_flags, zero_ns)
+            else:
+                self._offload_grad_sh = zero_ns
+                self._offload_region_sh = zero_ns
             self._shardings = TrainState(
                 step=rep, micro_step=rep, params=param_sh, opt_state=(),
                 master=None, accum=(),
@@ -563,10 +588,20 @@ class DeepSpeedEngine:
             scaler = make_loss_scale_state(self._host_scaler.cur_scale)
         self._host_skipped = 0
 
+        # scalars must carry the mesh's replicated sharding (not
+        # SingleDeviceSharding): multi-process checkpointing can only
+        # serialize globally-addressable arrays
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rep = NamedSharding(self.mesh, P())
+        put_rep = lambda x: jax.device_put(x, rep)
+        if scaler is not None:
+            scaler = jax.tree_util.tree_map(put_rep, scaler)
         self.state = TrainState(
-            step=jnp.int32(0), micro_step=jnp.int32(0), params=params,
-            opt_state=(), master=None, accum=(), scaler=scaler,
-            skipped_steps=jnp.int32(0), rng=state_rng)
+            step=put_rep(jnp.int32(0)), micro_step=put_rep(jnp.int32(0)),
+            params=params, opt_state=(), master=None, accum=(),
+            scaler=scaler, skipped_steps=put_rep(jnp.int32(0)),
+            rng=put_rep(state_rng))
         n_params = sum(l.size for l in self._host_master_flat)
         log_dist(
             f"Initialized ZeRO-Offload state: {n_params/1e6:.1f}M params "
@@ -717,6 +752,8 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps()
         model = self.module
 
+        sparse_flags = getattr(self, "_offload_sparse_flags", None)
+
         def micro(state: TrainState, batch):
             rng = jax.random.fold_in(state.rng,
                                      state.micro_step + state.step * 131071)
@@ -730,6 +767,34 @@ class DeepSpeedEngine:
             grads, loss = jax.grad(loss_fn, has_aux=True)(state.params)
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), grads)
+            if sparse_flags is not None:
+                from deepspeed_tpu.runtime.csr_tensor import CSRTensor
+
+                # row capacity (static per trace): an embedding grad has
+                # nonzero rows only for looked-up ids, so (indices, values)
+                # @ capacity rows beat the dense (vocab, dim) table on the
+                # D2H wire. Models declare their lookup-token count via
+                # sparse_grad_tokens(batch); the fallback counts every
+                # integer leaf, which over-reserves when labels/masks ride
+                # along (correct, just a smaller saving).
+                if hasattr(model, "sparse_grad_tokens"):
+                    tokens = int(model.sparse_grad_tokens(batch))
+                else:
+                    tokens = sum(
+                        int(np.prod(l.shape))
+                        for l in jax.tree_util.tree_leaves(batch)
+                        if jnp.issubdtype(jnp.asarray(l).dtype, jnp.integer))
+
+                def maybe_csr(flag, g):
+                    if not flag:
+                        return g
+                    cap = min(max(tokens, 1), g.shape[0])
+                    csr = CSRTensor.from_dense(g, max_rows=cap)
+                    return {"csr_indices": csr.indices,
+                            "csr_values": csr.values}
+
+                grads = jax.tree_util.tree_map(maybe_csr, sparse_flags,
+                                               grads)
             new_state = state._replace(micro_step=state.micro_step + 1)
             return new_state, loss, grads
 
@@ -752,7 +817,7 @@ class DeepSpeedEngine:
 
         my_proc = jax.process_index()
         regions = []
-        sh_flat = jax.tree_util.tree_leaves(self._offload_grad_sh)
+        sh_flat = jax.tree_util.tree_leaves(self._offload_region_sh)
         for i, (master, sh) in enumerate(zip(self._host_master_flat,
                                              sh_flat)):
             imap = sh.devices_indices_map(tuple(master.shape))
@@ -772,27 +837,42 @@ class DeepSpeedEngine:
         self._offload_regions_cache = regions
         return regions
 
+    @staticmethod
+    def _is_csr_leaf(x):
+        return isinstance(x, dict) and "csr_indices" in x
+
     def _start_grad_fetch(self, grads):
         """Kick off async D2H copies of this process's grad shards; returns
-        the leaves for later consumption. The copy overlaps the next
+        the per-master-leaf list (dense arrays or CSR {indices, values}
+        pairs) for later consumption. The copy overlaps the next
         micro-batch's device compute (reference stage2.py:876-958 overlaps
         D2H on a side stream the same way)."""
         import jax
 
-        flat = jax.tree_util.tree_leaves(grads)
+        flat = jax.tree_util.tree_flatten(grads, is_leaf=self._is_csr_leaf)[0]
         for leaf in flat:
-            for s in leaf.addressable_shards:
-                s.data.copy_to_host_async()
+            arrs = ([leaf["csr_indices"], leaf["csr_values"]]
+                    if self._is_csr_leaf(leaf) else [leaf])
+            for a in arrs:
+                for s in a.addressable_shards:
+                    s.data.copy_to_host_async()
         return flat
 
     def _consume_grad_fetch(self, flat):
         """Accumulate a fetched micro-batch's local grad shards into the
         host fp32 buffers (allocated lazily, full-shape; only this
-        process's regions are ever touched)."""
+        process's regions are ever touched). CSR leaves scatter-add their
+        valid rows into the full-shape buffer."""
         if self._host_grad_accum is None:
             self._host_grad_accum = [np.zeros(m.shape, np.float32)
                                      for m in self._host_master_flat]
         for buf, leaf in zip(self._host_grad_accum, flat):
+            if self._is_csr_leaf(leaf):
+                idx = np.asarray(leaf["csr_indices"])
+                vals = np.asarray(leaf["csr_values"], dtype=np.float32)
+                valid = idx >= 0
+                np.add.at(buf, idx[valid], vals[valid])
+                continue
             seen = set()
             for s in leaf.addressable_shards:
                 key = tuple((sl.start, sl.stop, sl.step) for sl in s.index)
@@ -815,7 +895,7 @@ class DeepSpeedEngine:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        sh_flat = jax.tree_util.tree_leaves(self._offload_grad_sh)
+        sh_flat = jax.tree_util.tree_leaves(self._offload_region_sh)
         rep = NamedSharding(self.mesh, P())
         if not hasattr(self, "_jit_replicate"):
             # one cached identity: jit retraces per shape, not per call
@@ -842,7 +922,7 @@ class DeepSpeedEngine:
         import jax
 
         dtype_name = str(jax.numpy.dtype(self.compute_dtype))
-        sh_flat = jax.tree_util.tree_leaves(self._offload_grad_sh)
+        sh_flat = jax.tree_util.tree_leaves(self._offload_region_sh)
         param_sh_flat = jax.tree_util.tree_leaves(self._shardings.params)
         sharded = []
         for i, (master, gsh) in enumerate(zip(self._host_master_flat,
@@ -1098,9 +1178,15 @@ class DeepSpeedEngine:
 
         sh = self._shardings
         if self.gradient_clipping():
-            log_dist("1-bit Adam wire path ignores gradient_clipping "
-                     "(reference onebit_adam.py has no global-norm clip)",
-                     ranks=[0])
+            # global-norm clipping needs the dense mean gradient — exactly
+            # the collective the wire path exists to avoid (cross terms make
+            # ||mean(g_i)|| incomputable from local norms). Refusing beats
+            # silently training differently at dp>1 than at dp=1.
+            raise ValueError(
+                "gradient_clipping is incompatible with the 1-bit Adam "
+                "wire-compression path (post-freeze there is no dense "
+                "gradient to clip). Disable clipping, or set optimizer "
+                "params comm_backend_name='none' to keep the dense path.")
         self._jit_micro = jax.jit(self._make_micro_fn(),
                                   out_shardings=(sh, None))
         self._onebit_fused_fns = {b: self._make_onebit_fused(b)
@@ -1371,12 +1457,18 @@ class DeepSpeedEngine:
                      ranks=[0])
 
         import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
+        # fresh scalars take the replicated mesh sharding: host-local
+        # SingleDeviceSharding scalars cannot be checkpointed multi-process
+        put_rep = lambda x: jax.device_put(
+            x, NamedSharding(self.mesh, P()))
         scaler = self.state.scaler
         if scaler is not None and new_scale != scale:
-            scaler = make_loss_scale_state(new_scale)
+            scaler = jax.tree_util.tree_map(
+                put_rep, make_loss_scale_state(new_scale))
         self.state = self.state._replace(
-            micro_step=jnp.int32(0),
+            micro_step=put_rep(jnp.int32(0)),
             step=self.state.step + 1, scaler=scaler)
         self.global_steps += 1
         if self.progressive_layer_drop is not None:
@@ -1680,6 +1772,15 @@ class DeepSpeedEngine:
             data = np.load(os.path.join(path, "model_states.npz"))
             flat = npz_dict_to_leaves(data)
             assert len(flat) == meta["num_leaves"]
+            cur_leaves = len(jax.tree_util.tree_leaves(self.state))
+            if len(flat) != cur_leaves:
+                raise ValueError(
+                    f"checkpoint at {path} holds {len(flat)} state leaves "
+                    f"but this engine's TrainState has {cur_leaves} — the "
+                    f"checkpoint was saved by an older engine revision or "
+                    f"under a different config (e.g. pre-round-4 offload "
+                    f"states carried a device grad accumulator); re-save "
+                    f"with the current version")
             host_state = jax.tree_util.tree_unflatten(treedef, flat)
             # re-shard onto the current mesh: elastic by construction — the
             # full arrays repartition to any world size (reference

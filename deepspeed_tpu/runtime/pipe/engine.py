@@ -124,7 +124,12 @@ class PipelineEngine(DeepSpeedEngine):
         return self._pipe_scaler.cur_scale
 
     def is_first_stage(self):
-        return True   # single controller drives all stages
+        """True: the single controller owns every stage, including stage 0
+        (reference semantics — 'does this rank host the first stage' — are
+        per-rank; here one process IS all ranks, so both predicates hold
+        and first/last-stage-only work like data loading and loss handling
+        runs on this process)."""
+        return True
 
     def is_last_stage(self):
         return True
@@ -263,9 +268,24 @@ class PipelineEngine(DeepSpeedEngine):
                 loss, _ = loss_fn(out, batch)
                 return loss
 
+            rep_sh, zero_sh, opt_sh = self._stage_shardings[s]
+
+            def accum_add(accum, gp, zero_sh=zero_sh):
+                # pin the ZeRO layout: without the constraint XLA is free to
+                # re-lay-out the donated accumulator after the add
+                return jax.tree_util.tree_map(
+                    lambda a, g, sh: jax.lax.with_sharding_constraint(
+                        a + g.astype(jnp.float32), sh),
+                    accum, gp, zero_sh)
+
             # NOTE: closures bind loop-locals via default args — a bare
-            # reference would late-bind to the LAST stage's function
-            def bwd_last(params, x, rng, batch, scale, fwd_loss=fwd_loss):
+            # reference would late-bind to the LAST stage's function.
+            # backward + gradient accumulation are ONE jit (donated accum):
+            # the host-driven schedule pays one dispatch per BackwardPass
+            # instead of two, and the grads never materialize outside the
+            # accumulator.
+            def bwd_last(params, accum, x, rng, batch, scale,
+                         fwd_loss=fwd_loss, accum_add=accum_add):
                 def scaled(params, x):
                     loss = fwd_loss(params, x, rng, batch)
                     return loss.astype(jnp.float32) * scale / gas, loss
@@ -280,22 +300,13 @@ class PipelineEngine(DeepSpeedEngine):
                     (_, loss), gp = jax.value_and_grad(
                         scaled, argnums=0, has_aux=True)(params, x)
                     gx = jnp.zeros((), jnp.float32)
-                return gp, gx, loss
+                return accum_add(accum, gp), gx, loss
 
-            def bwd_mid(params, x, rng, gy, fwd=fwd):
+            def bwd_mid(params, accum, x, rng, gy, fwd=fwd,
+                        accum_add=accum_add):
                 _, vjp = jax.vjp(lambda p, x: fwd(p, x, rng), params, x)
                 gp, gx = vjp(gy)
-                return gp, gx
-
-            rep_sh, zero_sh, opt_sh = self._stage_shardings[s]
-
-            def accum_add(accum, gp, zero_sh=zero_sh):
-                # pin the ZeRO layout: without the constraint XLA is free to
-                # re-lay-out the donated accumulator after the add
-                return jax.tree_util.tree_map(
-                    lambda a, g, sh: jax.lax.with_sharding_constraint(
-                        a + g.astype(jnp.float32), sh),
-                    accum, gp, zero_sh)
+                return accum_add(accum, gp), gx
 
             def sqnorm(accum):
                 total = jnp.float32(0.0)
@@ -351,13 +362,15 @@ class PipelineEngine(DeepSpeedEngine):
             submesh = self._submeshes[s]
             jits = {
                 "fwd": jax.jit(fwd),
-                "bwd_last": jax.jit(bwd_last) if is_last else None,
-                "bwd_mid": jax.jit(bwd_mid),
-                "accum_add": jax.jit(accum_add, donate_argnums=(0,)),
+                "bwd_last": jax.jit(bwd_last, donate_argnums=(1,))
+                if is_last else None,
+                "bwd_mid": jax.jit(bwd_mid, donate_argnums=(1,)),
                 "sqnorm": jax.jit(sqnorm),
                 "apply_step": jax.jit(apply_step, donate_argnums=(0,)),
                 "eval_fwd": jax.jit(eval_fwd),
                 "eval_loss": jax.jit(eval_loss) if is_last else None,
+                "mean_loss": jax.jit(
+                    lambda ls: jnp.stack(ls).mean()) if is_last else None,
                 "mesh": submesh,
             }
             self._stage_jits.append(jits)
@@ -449,7 +462,10 @@ class PipelineEngine(DeepSpeedEngine):
         self.global_steps += 1
         self.micro_steps += self.micro_batches
         self.tput_timer.stop()
-        loss = float(np.mean([float(jax.device_get(l)) for l in losses]))
+        # one reduction + one transfer instead of gas scalar fetches
+        with jax.set_mesh(self._submeshes[-1]):
+            loss = float(jax.device_get(
+                self._stage_jits[-1]["mean_loss"](losses)))
         self._last_loss = loss
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps)
@@ -565,17 +581,17 @@ class PipelineEngine(DeepSpeedEngine):
                         bwd_ptr[s] += 1
                         with jax.set_mesh(self._submeshes[s]):
                             if s == S - 1:
-                                gp, gx, loss = jits["bwd_last"](
-                                    st.params, in_act[s][buf], rng,
+                                new_accum, gx, loss = jits["bwd_last"](
+                                    st.params, st.accum, in_act[s][buf], rng,
                                     micro_dev[s][buf],
                                     np.float32(self._pipe_scaler.cur_scale))
                                 losses.append(loss)
                             else:
-                                gp, gx = jits["bwd_mid"](
-                                    st.params, in_act[s][buf], rng,
+                                new_accum, gx = jits["bwd_mid"](
+                                    st.params, st.accum, in_act[s][buf], rng,
                                     in_grad[s][buf])
                             self.stage_states[s] = st._replace(
-                                accum=jits["accum_add"](st.accum, gp))
+                                accum=new_accum)
                             st = self.stage_states[s]
                             out_grad[s][buf] = gx
                         # free consumed buffers

@@ -148,3 +148,47 @@ def make_stack_specs(hidden_dim, n_layers, n_classes=4, tied_head=False):
         return loss, {"loss": loss}
 
     return specs, loss_fn, (lambda batch: batch["x"])
+
+
+class SimpleEmbedModel:
+    """Untied-embedding classifier: ids -> embedding -> mean-pool -> linear.
+
+    The embedding gradient is row-sparse (only looked-up ids get grads) and
+    the table is NOT reused as an output head — the shape the reference's
+    sparse_gradients path targets (reference engine.py:187-193)."""
+
+    def __init__(self, vocab=256, dim=8, n_classes=4):
+        self.vocab = vocab
+        self.dim = dim
+        self.n_classes = n_classes
+
+    def init(self, rng, batch):
+        import jax
+
+        k1, k2 = jax.random.split(rng)
+        return {
+            "emb": jax.random.normal(k1, (self.vocab, self.dim)) * 0.1,
+            "w": jax.random.normal(k2, (self.dim, self.n_classes)) * 0.1,
+            "b": jax.numpy.zeros((self.n_classes,)),
+        }
+
+    def loss(self, params, batch, rng, train=True):
+        import jax
+        import jax.numpy as jnp
+
+        ids = batch["ids"]                         # (B, S) int
+        x = params["emb"][ids].mean(axis=1)        # (B, dim)
+        logits = (x @ params["w"] + params["b"]).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(
+            logp, batch["y"][:, None], axis=1))
+        return loss, {"loss": loss}
+
+    def sparse_grad_spec(self, params):
+        """Engine contract: True for leaves whose gradient is row-sparse."""
+        return {"emb": True, "w": False, "b": False}
+
+    def sparse_grad_tokens(self, batch):
+        """Engine contract: lookup-token count = CSR row capacity (labels
+        and masks don't index the table and must not inflate it)."""
+        return batch["ids"].size

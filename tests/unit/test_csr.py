@@ -76,3 +76,68 @@ def test_allgather_csr_sums_shards(eight_devices):
     expected = sum(dense)
     for w in range(W):
         np.testing.assert_allclose(out[w], expected, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# engine sparse_gradients wiring (round 4): the config flag routes untied
+# embedding grads through CSR on the offload D2H path
+# ---------------------------------------------------------------------------
+
+def _embed_engine(sparse, vocab=256):
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleEmbedModel
+
+    model = SimpleEmbedModel(vocab=vocab, dim=8)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config_params={
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.05}},
+        "zero_optimization": {"stage": 2, "cpu_offload": True},
+        "sparse_gradients": sparse,
+        "mesh": {"data": 8}, "steps_per_print": 10 ** 9})
+    return engine
+
+
+def test_sparse_gradients_offload_matches_dense(eight_devices):
+    """sparse_gradients=True must train identically to the dense offload
+    path — CSR streaming is a wire-format change, not a numerics change."""
+    import jax
+
+    rng = np.random.default_rng(0)
+    batches = [{"ids": rng.integers(0, 256, (8, 4)),
+                "y": rng.integers(0, 4, (8,)).astype(np.int32)}
+               for _ in range(6)]
+
+    def run(sparse):
+        engine = _embed_engine(sparse)
+        return engine, [float(jax.device_get(engine.train_batch(batch={
+            k: v[None] for k, v in b.items()}))) for b in batches]
+
+    e_dense, dense = run(False)
+    e_sparse, sparse = run(True)
+    assert e_sparse._offload_sparse_flags == {"emb": True, "w": False,
+                                              "b": False}
+    np.testing.assert_allclose(dense, sparse, rtol=1e-5, atol=1e-7)
+    assert sparse[-1] < sparse[0]
+
+
+def test_sparse_gradients_shrinks_grad_transfer(eight_devices):
+    """The streamed embedding grad must be (tokens, dim) rows, not the
+    (vocab, dim) table: ~vocab/tokens less D2H traffic."""
+    import jax
+
+    engine = _embed_engine(True, vocab=256)
+    rng = np.random.default_rng(0)
+    batch = {"ids": rng.integers(0, 256, (1, 8, 4)),
+             "y": rng.integers(0, 4, (1, 8)).astype(np.int32)}
+    engine.train_batch(batch=batch)
+    # inspect the micro output structure directly
+    dev = engine._shard_batch({k: v[0] for k, v in batch.items()})
+    with jax.set_mesh(engine.mesh):
+        _, _, grads = engine._jit_micro(engine.state, dev)
+    assert engine._is_csr_leaf(grads["emb"])
+    rows = grads["emb"]["csr_values"].shape
+    # capacity = lookup tokens only (sparse_grad_tokens): 8*4 ids
+    assert rows == (8 * 4, 8), rows
+    assert rows[0] < 256, "CSR values must be smaller than the dense table"
+    # dense leaves stay dense
+    assert not engine._is_csr_leaf(grads["w"])

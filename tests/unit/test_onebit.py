@@ -318,3 +318,23 @@ def test_onebit_wire_gpt2_with_sharding_constraints(eight_devices, mesh):
               for _ in range(4)]  # crosses freeze_step=2
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_onebit_wire_rejects_gradient_clipping(eight_devices):
+    """Silent behavior drift between dp=1 (clipped) and dp>1 (wire path,
+    unclippable) is worse than a loud error."""
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel
+
+    with pytest.raises(ValueError, match="wire-compression"):
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=16), config_params={
+                "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+                "gradient_clipping": 1.0,
+                "optimizer": {"type": "OneBitAdam",
+                              "params": {"lr": 1e-2, "freeze_step": 3}},
+                "mesh": {"data": 8}, "steps_per_print": 10 ** 9})
+        rng = np.random.default_rng(0)
+        engine.train_batch(batch={
+            "x": rng.standard_normal((1, 8, 16)).astype(np.float32),
+            "y": rng.integers(0, 4, (1, 8)).astype(np.int32)})

@@ -1,5 +1,9 @@
 """Sweep flash-attention block sizes on the real chip; checks numerics vs the
-jnp reference path at each config."""
+jnp reference path at each config.
+
+--chain N (5th positional arg) wraps N sequential attention calls in ONE jit
+so the tunnel's per-dispatch overhead (~3ms) doesn't swamp the kernel time —
+representative of 24 layers inside a fused train step."""
 import os
 import sys
 import time
@@ -18,10 +22,19 @@ BS = int(sys.argv[1]) if len(sys.argv) > 1 else 8
 H = int(sys.argv[2]) if len(sys.argv) > 2 else 16
 SEQ = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
 D = int(sys.argv[4]) if len(sys.argv) > 4 else 64
+CHAIN = int(sys.argv[5]) if len(sys.argv) > 5 else 1
 ITERS = 20
 
 
-def bench(fn, *args, flops):
+def bench(att_fn, *args, flops):
+    def chained(q, k, v):
+        y = q
+        for _ in range(CHAIN):
+            y = att_fn(y, k, v)
+        return y
+
+    fn = jax.jit(chained)
+    flops = flops * CHAIN
     o = fn(*args)
     jax.block_until_ready(o)
     jax.device_get(jax.tree_util.tree_leaves(o)[0].ravel()[0])

@@ -1,0 +1,132 @@
+"""Injection policies + the generic param-tree walker.
+
+Reference behavior: deepspeed/module_inject/replace_module.py:93-161 walks a
+torch module tree and swaps every instance of a policy's `orig_class` for
+the fused layer (and back). Models here are (module, params) pairs, so the
+walker operates on the PARAM tree: a policy declares how to recognize one
+layer subtree by name and how to map its params onto the fused layer's (and
+back). New architectures plug in by registering a policy instead of editing
+the walker — the reference's policy-class extension point.
+"""
+import re
+
+import numpy as np
+
+
+class LayerPolicy:
+    """One injectable layer family.
+
+    match(name) -> layer index (int) or None;
+    inject(subtree) -> fused-layer params;
+    revert(fused_params) -> original subtree;
+    out_name(i) -> the replaced layer's name in the output tree.
+    """
+
+    layer_pattern = r"^layer_?(\d+)$"
+    out_prefix = "layer_"
+
+    def match(self, name):
+        m = re.match(self.layer_pattern, str(name))
+        return int(m.group(1)) if m else None
+
+    def out_name(self, i):
+        return f"{self.out_prefix}{i}"
+
+    def inject(self, subtree):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def revert(self, subtree, hidden_size):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class HFBertLayerPolicy(LayerPolicy):
+    """HF-Flax BertLayer <-> DeepSpeedTransformerLayer (the reference's
+    HFBertLayerPolicy analog; fuses q/k/v into the qkv parameter)."""
+
+    def __init__(self, preln=False):
+        self.preln = preln
+
+    def inject(self, subtree):
+        from deepspeed_tpu.module_inject.replace_module import (
+            inject_bert_layer_params)
+
+        return inject_bert_layer_params(subtree, preln=self.preln)
+
+    def revert(self, subtree, hidden_size):
+        from deepspeed_tpu.module_inject.replace_module import (
+            revert_bert_layer_params)
+
+        return revert_bert_layer_params(subtree, hidden_size)
+
+
+POLICY_REGISTRY = {"bert": HFBertLayerPolicy}
+
+
+def register_policy(name, policy_cls):
+    POLICY_REGISTRY[name] = policy_cls
+
+
+def replace_module_params(params, policy: LayerPolicy, recurse=True):
+    """Walk a nested param dict; wherever a child name matches the policy's
+    layer pattern, replace that subtree via policy.inject. Non-matching
+    dicts are recursed (reference replace_module walks arbitrary depth).
+
+    Returns (new_tree, n_replaced)."""
+    n = 0
+
+    def walk(tree):
+        nonlocal n
+        if not isinstance(tree, dict):
+            return tree
+        out = {}
+        for name, sub in tree.items():
+            idx = policy.match(name) if isinstance(sub, dict) else None
+            if idx is not None:
+                out[policy.out_name(idx)] = policy.inject(sub)
+                n += 1
+            elif recurse:
+                out[name] = walk(sub)
+            else:
+                out[name] = sub
+        return out
+
+    new = walk(params)
+    return new, n
+
+
+def _t(x):
+    """HF-Flax GPT-2 stores Conv1D kernels (out, in); ours are flax Dense
+    (in, out)."""
+    x = np.asarray(x)
+    return x.T if x.ndim == 2 else x
+
+
+def load_hf_gpt2_params(hf_params):
+    """transformers FlaxGPT2LMHeadModel params -> models/gpt2.GPT2LMHead
+    params (non-scan layout): bring pretrained HF GPT-2 weights into this
+    framework. Layer subtrees keep their structure (ln_1/attn/ln_2/mlp);
+    2D kernels transpose from HF's (out, in) Conv1D layout."""
+    t = hf_params.get("transformer", hf_params)
+    out = {
+        "wte": np.asarray(t["wte"]["embedding"]),
+        "wpe": np.asarray(t["wpe"]["embedding"]),
+        "ln_f": {k: np.asarray(v) for k, v in t["ln_f"].items()},
+    }
+    for i, layer in t["h"].items():
+        out[f"h_{int(i)}"] = {
+            "ln_1": {k: np.asarray(v) for k, v in layer["ln_1"].items()},
+            "ln_2": {k: np.asarray(v) for k, v in layer["ln_2"].items()},
+            "attn": {
+                "c_attn": {"kernel": _t(layer["attn"]["c_attn"]["kernel"]),
+                           "bias": np.asarray(layer["attn"]["c_attn"]["bias"])},
+                "c_proj": {"kernel": _t(layer["attn"]["c_proj"]["kernel"]),
+                           "bias": np.asarray(layer["attn"]["c_proj"]["bias"])},
+            },
+            "mlp": {
+                "c_fc": {"kernel": _t(layer["mlp"]["c_fc"]["kernel"]),
+                         "bias": np.asarray(layer["mlp"]["c_fc"]["bias"])},
+                "c_proj": {"kernel": _t(layer["mlp"]["c_proj"]["kernel"]),
+                           "bias": np.asarray(layer["mlp"]["c_proj"]["bias"])},
+            },
+        }
+    return out

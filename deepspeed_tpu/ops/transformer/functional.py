@@ -25,11 +25,18 @@ def scaled_dot_product_attention(q, k, v, *, mask=None, bias=None, causal=False,
     if use_pallas is None:
         use_pallas = _pallas_attention_ok(q, k, v, mask, bias, dropout_rate)
     if use_pallas:
-        assert mask is None and dropout_rate == 0.0, (
+        assert dropout_rate == 0.0, (
             "pallas flash attention supports causal masking and additive "
-            "bias; boolean mask/dropout require use_pallas=False (jnp path)")
+            "bias; dropout requires use_pallas=False (jnp path)")
         from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
 
+        if mask is not None:
+            # boolean keep-mask -> additive bias (the kernel's in-block
+            # form); combined with any explicit bias by addition, matching
+            # the jnp path's where(mask, logits+bias, -inf)
+            mask_bias = jnp.where(mask, jnp.float32(0.0), jnp.float32(-1e30))
+            bias = mask_bias if bias is None else bias + mask_bias
+            mask = None
         return flash_attention(q, k, v, bias=bias, causal=causal, scale=scale)
 
     head_dim = q.shape[-1]
@@ -53,21 +60,25 @@ def scaled_dot_product_attention(q, k, v, *, mask=None, bias=None, causal=False,
 
 
 def _pallas_attention_ok(q, k, v, mask, bias, dropout_rate) -> bool:
-    # Pallas path: TPU backend, no boolean mask / dropout (causal and
-    # additive bias handled in-kernel), seq and head_dim aligned to MXU
-    # tiles. Bias must be 4D and broadcastable to (B, H, S_q, S_k); its
-    # gradient is not produced (fine for constant masks — a learned bias
-    # needs use_pallas=False).
-    if mask is not None or dropout_rate > 0.0:
+    # Pallas path: TPU backend, no dropout (causal, additive bias, and
+    # boolean keep-masks handled in-kernel), seq and head_dim aligned to
+    # MXU tiles. Bias/mask gradients are not produced (fine for constant
+    # masks — a learned bias needs use_pallas=False).
+    if dropout_rate > 0.0:
         return False
-    if bias is not None:
-        # auto-dispatch only for key-padding-shaped biases (B, 1, 1, S_k) —
-        # in practice always constant masks. A full (learned) bias would
-        # silently get zero gradient through the kernel; it must opt in
-        # with use_pallas=True.
-        if getattr(bias, "ndim", 0) != 4 or bias.shape[1] != 1 \
-                or bias.shape[2] != 1:
-            return False
+
+    def key_padding_shaped(m):
+        # auto-dispatch only for key-padding-shaped (B, 1, 1, S_k) masks/
+        # biases — in practice always constants. A full (learned) bias
+        # would silently get zero gradient through the kernel; it must opt
+        # in with use_pallas=True.
+        return (getattr(m, "ndim", 0) == 4 and m.shape[1] == 1
+                and m.shape[2] == 1)
+
+    if bias is not None and not key_padding_shaped(bias):
+        return False
+    if mask is not None and not key_padding_shaped(mask):
+        return False
     try:
         if jax.default_backend() not in ("tpu",):
             return False

@@ -100,13 +100,20 @@ def constrain(x, spec):
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty:
         return x
-    manual = set(getattr(mesh, "manual_axes", ()) or ())
+    # with_sharding_constraint accepts only Auto axes: under shard_map the
+    # mapped axes are Manual and the rest become Explicit, so both must be
+    # dropped here (checked up front — genuine spec errors like rank
+    # mismatch still surface from with_sharding_constraint itself)
+    auto = getattr(mesh, "auto_axes", None)
+    if auto is None:  # pragma: no cover - older jax
+        manual = set(getattr(mesh, "manual_axes", ()) or ())
+        auto = tuple(a for a in mesh.shape if a not in manual)
 
     def keep(axis):
         if axis is None:
             return None
         axes = axis if isinstance(axis, tuple) else (axis,)
-        kept = tuple(a for a in axes if a in mesh.shape and a not in manual)
+        kept = tuple(a for a in axes if a in mesh.shape and a in auto)
         if not kept:
             return None
         return kept if len(kept) > 1 else kept[0]
@@ -114,15 +121,7 @@ def constrain(x, spec):
     cleaned = P(*(keep(a) for a in spec))
     if all(a is None for a in cleaned):
         return x
-    try:
-        return jax.lax.with_sharding_constraint(x, cleaned)
-    except ValueError:
-        if manual:
-            # inside a shard_map: remaining axes may not be Auto under its
-            # typing — the constraint is an optimization hint, never
-            # load-bearing, so dropping it there is always safe
-            return x
-        raise  # genuine spec errors (rank mismatch etc.) must surface
+    return jax.lax.with_sharding_constraint(x, cleaned)
 
 
 def data_sharding(mesh, *, extra_dims: int = 1):

@@ -454,8 +454,13 @@ class DeepSpeedConfig:
         assert self.gradient_accumulation_steps, \
             f"DeepSpeedConfig: {GRADIENT_ACCUMULATION_STEPS} is not defined"
         if self.zero_enabled:
-            assert self.zero_optimization_stage <= 2, \
-                "DeepSpeedConfig: Max supported ZeRO stage is 2 (parity with reference)"
+            from deepspeed_tpu.runtime.zero.constants import \
+                MAX_STAGE_ZERO_OPTIMIZATION
+
+            assert self.zero_optimization_stage <= MAX_STAGE_ZERO_OPTIMIZATION, \
+                (f"DeepSpeedConfig: Max supported ZeRO stage is "
+                 f"{MAX_STAGE_ZERO_OPTIMIZATION} (3 = param sharding, an "
+                 f"extension beyond the reference snapshot's cap of 2)")
 
     def _do_warning_check(self):
         fp16_enabled = self.fp16_enabled or self.zero_enabled

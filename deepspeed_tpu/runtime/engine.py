@@ -444,9 +444,13 @@ class DeepSpeedEngine:
             lambda s, l: mesh_lib.zero_merge_spec(s, l, dp) if stage > 0 else s,
             tp_spec, params_template, is_leaf=lambda x: isinstance(x, P))
 
-        param_sh = ns(tp_spec)
+        # stage 3 (extension; reference engine.py:720-722 caps at 2): the
+        # COMPUTE params also live ZeRO-sharded over 'data' — XLA all-gathers
+        # each weight at its use sites (fwd and, under remat, again in bwd),
+        # exactly stage-3's gather-on-demand, expressed as one spec choice
+        param_sh = ns(zero_spec) if stage >= 3 else ns(tp_spec)
         master_sh = ns(zero_spec) if self.mixed_precision else None
-        # accum: ZeRO-2 shards gradients; otherwise keep with param layout
+        # accum: ZeRO-2+ shards gradients; otherwise keep with param layout
         accum_sh = ns(zero_spec) if stage >= 2 else param_sh
 
         if self._offload:
@@ -474,7 +478,8 @@ class DeepSpeedEngine:
                 # {indices, values} pairs; region layout (for the host
                 # master/moment step) treats them as whole-buffer regions
                 self._offload_grad_sh = jax.tree_util.tree_map(
-                    lambda flag, s: {"csr_indices": rep, "csr_values": rep}
+                    lambda flag, s: {"csr_indices": rep, "csr_values": rep,
+                                     "csr_dropped": rep}
                     if flag else s,
                     self._offload_sparse_flags, zero_ns)
                 self._offload_region_sh = jax.tree_util.tree_map(
@@ -591,9 +596,7 @@ class DeepSpeedEngine:
         # scalars must carry the mesh's replicated sharding (not
         # SingleDeviceSharding): multi-process checkpointing can only
         # serialize globally-addressable arrays
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        rep = NamedSharding(self.mesh, P())
+        rep = mesh_lib.replicated(self.mesh)
         put_rep = lambda x: jax.device_put(x, rep)
         if scaler is not None:
             scaler = jax.tree_util.tree_map(put_rep, scaler)
@@ -784,14 +787,27 @@ class DeepSpeedEngine:
                         int(np.prod(l.shape))
                         for l in jax.tree_util.tree_leaves(batch)
                         if jnp.issubdtype(jnp.asarray(l).dtype, jnp.integer))
+                if tokens <= 0:
+                    raise ValueError(
+                        "sparse_gradients: cannot size the CSR row capacity "
+                        "— the batch has no integer leaves and the model "
+                        "does not define sparse_grad_tokens(batch); "
+                        "truncating rows would silently corrupt gradients")
 
                 def maybe_csr(flag, g):
                     if not flag:
                         return g
-                    cap = min(max(tokens, 1), g.shape[0])
+                    cap = min(tokens, g.shape[0])
                     csr = CSRTensor.from_dense(g, max_rows=cap)
+                    # capacity under-report (e.g. a wrong
+                    # sparse_grad_tokens) would silently DROP gradient
+                    # rows; the overflow count travels with the leaf and
+                    # the host consume raises on it
+                    nnz = jnp.sum(jnp.any(g != 0, axis=tuple(
+                        range(1, g.ndim))).astype(jnp.int32))
                     return {"csr_indices": csr.indices,
-                            "csr_values": csr.values}
+                            "csr_values": csr.values,
+                            "csr_dropped": jnp.maximum(nnz - cap, 0)}
 
                 grads = jax.tree_util.tree_map(maybe_csr, sparse_flags,
                                                grads)
@@ -851,8 +867,8 @@ class DeepSpeedEngine:
 
         flat = jax.tree_util.tree_flatten(grads, is_leaf=self._is_csr_leaf)[0]
         for leaf in flat:
-            arrs = ([leaf["csr_indices"], leaf["csr_values"]]
-                    if self._is_csr_leaf(leaf) else [leaf])
+            arrs = (list(leaf.values()) if self._is_csr_leaf(leaf)
+                    else [leaf])
             for a in arrs:
                 for s in a.addressable_shards:
                     s.data.copy_to_host_async()
@@ -868,6 +884,13 @@ class DeepSpeedEngine:
                                      for m in self._host_master_flat]
         for buf, leaf in zip(self._host_grad_accum, flat):
             if self._is_csr_leaf(leaf):
+                dropped = int(np.asarray(leaf["csr_dropped"]))
+                if dropped:
+                    raise RuntimeError(
+                        f"sparse_gradients: CSR capacity too small — "
+                        f"{dropped} nonzero gradient rows were dropped; "
+                        f"fix the model's sparse_grad_tokens(batch) to "
+                        f"report the true lookup-token count")
                 idx = np.asarray(leaf["csr_indices"])
                 vals = np.asarray(leaf["csr_values"], dtype=np.float32)
                 valid = idx >= 0
@@ -1457,12 +1480,10 @@ class DeepSpeedEngine:
                      ranks=[0])
 
         import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as P
 
         # fresh scalars take the replicated mesh sharding: host-local
         # SingleDeviceSharding scalars cannot be checkpointed multi-process
-        put_rep = lambda x: jax.device_put(
-            x, NamedSharding(self.mesh, P()))
+        put_rep = lambda x: jax.device_put(x, mesh_lib.replicated(self.mesh))
         scaler = self.state.scaler
         if scaler is not None and new_scale != scale:
             scaler = jax.tree_util.tree_map(

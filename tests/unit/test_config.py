@@ -99,9 +99,14 @@ def test_fp16_and_zero_parsing():
     assert config.gradient_clipping == 1.0
 
 
-def test_zero_stage3_rejected():
+def test_zero_stage3_accepted_stage4_rejected():
+    """Stage 3 (param sharding) is supported as an extension beyond the
+    reference snapshot; anything above is rejected."""
+    config = make_config({"train_batch_size": 8,
+                          "zero_optimization": {"stage": 3}})
+    assert config.zero_optimization_stage == 3
     with pytest.raises(AssertionError):
-        make_config({"train_batch_size": 8, "zero_optimization": {"stage": 3}})
+        make_config({"train_batch_size": 8, "zero_optimization": {"stage": 4}})
 
 
 def test_legacy_zero_bool():

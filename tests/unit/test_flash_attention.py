@@ -130,3 +130,22 @@ def test_flash_bias_constant_no_grad():
     g = jax.grad(lambda b: flash_attention(
         q, q, q, bias=b, interpret=True).sum())(bias)
     np.testing.assert_array_equal(np.asarray(g), 0.0)
+
+
+def test_boolean_keypad_mask_dispatches_and_matches():
+    """A boolean keep-mask (B,1,1,S) converts to additive bias in-kernel and
+    matches the jnp reference path."""
+    from deepspeed_tpu.ops.transformer.functional import (
+        scaled_dot_product_attention)
+
+    rng = np.random.default_rng(5)
+    B, H, S, D = 2, 2, 256, 64
+    q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    mask = np.ones((B, 1, 1, S), bool)
+    mask[0, ..., 180:] = False
+    mask = jnp.asarray(mask)
+    ref = scaled_dot_product_attention(q, q, q, mask=mask, use_pallas=False)
+    got = scaled_dot_product_attention(q, q, q, mask=mask, use_pallas=True)
+    # compare only unmasked query rows? mask is over KEYS: all rows valid
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)

@@ -42,8 +42,10 @@ def bench(att_fn, *args, flops):
     for _ in range(ITERS):
         o = fn(*args)
     jax.device_get(jax.tree_util.tree_leaves(o)[0].ravel()[0])
-    dt = (time.time() - t0) / ITERS
-    return dt, flops / dt / 1e12
+    # per-CALL time: the chain amortizes dispatch, the report stays
+    # comparable with --chain 1 runs
+    dt = (time.time() - t0) / ITERS / CHAIN
+    return dt, (flops / CHAIN) / dt / 1e12
 
 
 def main():

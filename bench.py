@@ -104,7 +104,8 @@ def run_worker(args) -> int:
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 2},
+        "zero_optimization": {"stage": 2,
+                              "cpu_offload": bool(args.offload)},
         "mesh": {"data": n_dev, "model": 1, "pipe": 1},
         "steps_per_print": 10 ** 9,
     }
@@ -159,7 +160,8 @@ def run_worker(args) -> int:
 
     print(json.dumps({
         "metric": f"{args.model} seq{args.seq} train TFLOPS/chip "
-                  f"(ZeRO-2 bf16, {n_dev} chip)",
+                  f"(ZeRO-2{'+offload' if args.offload else ''} bf16, "
+                  f"{n_dev} chip)",
         "value": round(tflops_per_chip, 2),
         "unit": "TFLOPS/chip",
         "vs_baseline": round(vs_baseline, 3),
@@ -186,7 +188,7 @@ def run_worker(args) -> int:
 def _attempt_cmd(base, spec):
     cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
     for k in ("model", "batch", "seq", "steps", "warmup", "scan_layers",
-              "remat", "allow_cpu", "loss_chunk"):
+              "remat", "allow_cpu", "loss_chunk", "offload"):
         cmd += [f"--{k}", str(spec.get(k, getattr(base, k)))]
     return cmd
 
@@ -197,9 +199,7 @@ def run_parent(args) -> int:
     # v5e), then progressively smaller / faster-compiling fallbacks
     # (round-1 lesson: first compile of 350m with remat over the tunnel
     # can exceed 10 min)
-    attempts = [
-        {"model": args.model, "batch": args.batch, "seq": args.seq,
-         "steps": args.steps, "timeout": args.budget_s},
+    ladder = [
         {"model": "gpt2-350m", "batch": 32, "seq": 1024, "steps": 15,
          "timeout": max(500, args.budget_s // 2)},
         {"model": "gpt2-350m", "batch": 16, "seq": 1024, "steps": 15,
@@ -209,6 +209,24 @@ def run_parent(args) -> int:
         {"model": "gpt2-125m", "batch": 4, "seq": 256, "steps": 5,
          "remat": 0, "timeout": 300},
     ]
+    # fallbacks must only ever get SMALLER than the requested config — a
+    # 125m request that failed must not escalate to a 350m attempt
+    size_rank = ["gpt2-125m", "gpt2-350m", "gpt2-760m", "gpt2-1.5b",
+                 "bert-base", "bert-large"]
+
+    def rank(m):
+        return size_rank.index(m) if m in size_rank else len(size_rank)
+
+    def not_bigger(spec):
+        if rank(spec["model"]) > rank(args.model):
+            return False
+        return spec["model"] != args.model or (
+            spec["batch"] * spec["seq"] < args.batch * args.seq)
+
+    attempts = [
+        {"model": args.model, "batch": args.batch, "seq": args.seq,
+         "steps": args.steps, "timeout": args.budget_s},
+    ] + [s for s in ladder if not_bigger(s)]
     if args.single_attempt:
         attempts = attempts[:1]
 
@@ -299,6 +317,8 @@ def main():
     p.add_argument("--single-attempt", action="store_true")
     p.add_argument("--allow_cpu", type=int, default=0,
                    help="debug only: let the worker publish a CPU number")
+    p.add_argument("--offload", type=int, default=0,
+                   help="ZeRO-Offload: host fp32 master + C++ AVX Adam")
     args = p.parse_args()
     if args.worker:
         return run_worker(args)

@@ -43,6 +43,14 @@ class GPT2Config:
                                    # keeps peak memory O(chunk*V) not O(B*S*V).
                                    # 8192 on v5e: scan overhead amortized to
                                    # parity with the dense head (round-4 sweep)
+    # Mixture-of-Experts (expert parallelism over the 'data' mesh axis;
+    # moe/sharded_moe.py). 0 experts = dense model. Every moe_layer_freq-th
+    # block (the odd ones, GShard-style alternation) swaps its MLP for MoE.
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_layer_freq: int = 2
+    moe_aux_loss_coef: float = 0.01
 
     @property
     def head_dim(self):
@@ -110,6 +118,7 @@ class MLP(nn.Module):
 
 class Block(nn.Module):
     config: GPT2Config
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool):
@@ -118,9 +127,19 @@ class Block(nn.Module):
         x = x + CausalSelfAttention(cfg, name="attn")(
             nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
                          name="ln_1")(x), train)
-        x = x + MLP(cfg, name="mlp")(
-            nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
-                         name="ln_2")(x), train)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                         name="ln_2")(x)
+        if self.use_moe:
+            from deepspeed_tpu.moe import MoE
+
+            ffn = MoE(num_experts=cfg.moe_num_experts, d_ff=4 * cfg.n_embd,
+                      k=cfg.moe_top_k,
+                      capacity_factor=cfg.moe_capacity_factor,
+                      aux_loss_coef=cfg.moe_aux_loss_coef,
+                      dtype=cfg.dtype, name="moe")
+        else:
+            ffn = MLP(cfg, name="mlp")
+        x = x + ffn(h, train)
         # keep activations sharded batch-over-data as blocks stack
         x = mesh_lib.constrain(x, P("data", None, None))
         return x
@@ -144,7 +163,16 @@ class GPT2LMHead(nn.Module):
         block = Block
         if cfg.remat:
             block = nn.remat(Block, static_argnums=(2,))
-        if cfg.scan_layers:
+        if cfg.moe_num_experts:
+            # heterogeneous layers (dense/MoE alternation) can't share one
+            # scanned body; unrolled loop only
+            assert not cfg.scan_layers, \
+                "moe_num_experts > 0 requires scan_layers=False"
+            for i in range(cfg.n_layer):
+                x = block(cfg, name=f"h_{i}",
+                          use_moe=(i % cfg.moe_layer_freq
+                                   == cfg.moe_layer_freq - 1))(x, train)
+        elif cfg.scan_layers:
             # ONE traced block scanned over stacked (L, ...) params: the
             # compiled program is depth-independent (big HLOs from unrolled
             # deep stacks are the main TPU compile-time cost)
@@ -187,6 +215,12 @@ def gpt2_tp_leaf_spec(joined: str, leaf, stacked: bool = False):
     """
     if leaf.ndim == 0:
         return P()
+    if "moe" in joined:
+        from deepspeed_tpu.moe import moe_leaf_spec
+
+        spec = moe_leaf_spec(joined, leaf)
+        if spec is not None:
+            return spec
     lead = (None,) if stacked else ()
     if "wte" in joined:
         return P("model", None)
@@ -214,20 +248,38 @@ class GPT2Model:
                                 batch["input_ids"], train=False)["params"]
 
     def loss(self, params, batch, rng, train=True):
-        chunk = self.config.loss_chunk_tokens
-        if chunk:
-            hidden, wte = self.module.apply(
+        cfg = self.config
+        chunk = cfg.loss_chunk_tokens
+
+        def apply(**kw):
+            if cfg.moe_num_experts:
+                out, col = self.module.apply(
+                    {"params": params}, batch["input_ids"], train=train,
+                    rngs={"dropout": rng}, mutable=["losses"], **kw)
+                from deepspeed_tpu.moe import sum_moe_losses
+
+                return out, sum_moe_losses(col.get("losses", {}))
+            return self.module.apply(
                 {"params": params}, batch["input_ids"], train=train,
-                return_hidden=True, rngs={"dropout": rng})
+                rngs={"dropout": rng}, **kw), None
+
+        if chunk:
+            (hidden, wte), aux = apply(return_hidden=True)
             # next-token LM loss, chunked head (no full-logits residual)
-            return chunked_lm_cross_entropy(
+            loss, metrics = chunked_lm_cross_entropy(
                 hidden[:, :-1], wte, batch["labels"][:, 1:],
                 chunk_tokens=chunk, ignore_index=-100)
-        logits = self.module.apply({"params": params}, batch["input_ids"],
-                                   train=train, rngs={"dropout": rng})
-        # next-token LM loss
-        return cross_entropy_loss(logits[:, :-1], batch["labels"][:, 1:],
-                                  ignore_index=-100)
+        else:
+            logits, aux = apply()
+            # next-token LM loss
+            loss, metrics = cross_entropy_loss(
+                logits[:, :-1], batch["labels"][:, 1:], ignore_index=-100)
+        if aux is not None and train:
+            # the load-balance regularizer only exists to shape routing
+            # gradients; eval loss must stay comparable to dense models
+            loss = loss + aux
+            metrics = dict(metrics, moe_aux_loss=aux, loss=loss)
+        return loss, metrics
 
     def param_partition_spec(self, params):
         """Megatron-style TP layout over the 'model' axis:

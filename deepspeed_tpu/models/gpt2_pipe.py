@@ -74,6 +74,10 @@ def gpt2_pipeline_module(config: GPT2Config, partition_method="parameters",
                          activation_checkpoint_interval=0):
     """Build the LayerSpec pipeline for a GPT-2 config (TP specs included —
     with mesh model>1 this is the 3D PP x TP x DP configuration)."""
+    # MoE blocks sow an aux loss the pipeline's per-stage forward doesn't
+    # collect yet; refuse rather than silently train an all-dense model
+    assert not config.moe_num_experts, \
+        "moe_num_experts > 0 is not supported by the pipeline engine yet"
     specs = [TiedLayerSpec("embed", GPT2Embed, config,
                            partition_spec=_tp_spec)]
     for _ in range(config.n_layer):

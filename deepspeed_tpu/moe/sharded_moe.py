@@ -1,0 +1,225 @@
+"""Mixture-of-Experts with expert parallelism — TPU-native.
+
+The reference snapshot (v0.3.11) predates DeepSpeed-MoE (SURVEY §2.9: "EP:
+no — no MoE in this snapshot"), so this subsystem is a forward-looking
+extension in the spirit of the later ``deepspeed/moe/sharded_moe.py``,
+designed TPU-first rather than ported:
+
+- **Gating** (GShard top-2 / Switch top-1): dense one-hot dispatch and
+  combine tensors built from cumulative-sum position assignment — no
+  scatter, no dynamic shapes, everything lands on the MXU/VPU.
+- **Expert parallelism**: expert weights are stacked ``(E, ...)`` arrays
+  sharded over the 'data' mesh axis (ep_size == dp world size, the
+  DeepSpeed-MoE default). The token exchange is NOT hand-written: the
+  dispatched activations flip from token-sharded ``P('data', ...)`` to
+  expert-sharded ``P('data' on E, ...)`` via a sharding constraint, and
+  GSPMD inserts the all_to_all over ICI. Single-device meshes degrade to
+  plain dense einsums.
+- **Static capacity**: ``capacity = ceil(k * tokens * capacity_factor / E)``
+  is a Python int, so the jitted program has fixed shapes; overflow tokens
+  are dropped (their combine weight is zero) and ride the residual
+  connection, exactly like Switch Transformer.
+
+Load-balancing auxiliary loss follows Switch §2.2 / GShard §2.2(3):
+``aux = E * sum_e( fraction_tokens_e * mean_router_prob_e )`` — equals 1.0
+at perfect balance. Layers report it via flax ``sow('losses', ...)``; model
+loss heads add ``aux_loss_coef * aux``.
+"""
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+
+def moe_capacity(tokens_per_group: int, num_experts: int, k: int,
+                 capacity_factor: float, min_capacity: int = 4) -> int:
+    """Static per-expert slot count for one token group."""
+    cap = int(math.ceil(k * tokens_per_group * capacity_factor / num_experts))
+    return max(min_capacity, min(cap, tokens_per_group * k))
+
+
+def top_k_gating(logits, k: int = 2, capacity: Optional[int] = None,
+                 capacity_factor: float = 1.25, min_capacity: int = 4,
+                 normalize: bool = True):
+    """Dense top-k gating.
+
+    logits: (G, S, E) router scores (any float dtype; softmax runs fp32).
+    Returns (combine, dispatch, aux_loss, metrics):
+      combine:  (G, S, E, C) fp32 — weight of token (g,s) in expert e slot c
+      dispatch: (G, S, E, C) bool — combine > 0
+      aux_loss: scalar fp32 load-balance loss (≈1.0 when balanced)
+      metrics:  dict of scalars (expert load entropy, dropped fraction)
+    """
+    G, S, E = logits.shape
+    if capacity is None:
+        capacity = moe_capacity(S, E, k, capacity_factor, min_capacity)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    masks, gates = [], []
+    rem = probs
+    for _ in range(k):
+        idx = jnp.argmax(rem, axis=-1)
+        m = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # (G, S, E)
+        gates.append(jnp.sum(rem * m, axis=-1))        # (G, S)
+        masks.append(m)
+        rem = rem * (1.0 - m)
+
+    # load-balance loss on first-choice routing (Switch §2.2): product of
+    # per-expert token fraction and mean router probability
+    mean_prob = jnp.mean(probs, axis=(0, 1))           # (E,)
+    frac_tokens = jnp.mean(masks[0], axis=(0, 1))      # (E,)
+    aux_loss = E * jnp.sum(mean_prob * frac_tokens)
+
+    # normalize across the k chosen gates (GShard top-2). Never for k=1:
+    # Switch scales by the RAW router prob — a normalized top-1 gate is the
+    # constant 1 and the router would get no gradient through the output
+    normalize = normalize and k > 1
+    gate_sum = sum(gates)
+    combine = jnp.zeros((G, S, E, capacity), jnp.float32)
+    # slots an expert already handed out to higher-priority choices: the
+    # 2nd-choice positions start after ALL 1st-choice assignments (GShard's
+    # locations2 += sum(mask1))
+    offset = jnp.zeros((G, 1, E), jnp.float32)
+    kept_tokens = jnp.float32(0.0)
+    for m, g in zip(masks, gates):
+        loc = jnp.cumsum(m, axis=1) - m + offset       # (G, S, E)
+        pos = jnp.sum(loc * m, axis=-1)                # (G, S) slot index
+        chosen = jnp.sum(m, axis=-1)                   # (G, S) 0/1
+        keep = (pos < capacity).astype(jnp.float32) * chosen
+        kept_tokens = kept_tokens + jnp.sum(keep)
+        gn = g / jnp.maximum(gate_sum, 1e-9) if normalize else g
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                              dtype=jnp.float32)       # (G, S, C)
+        combine = combine + (gn * keep)[..., None, None] \
+            * m[..., None] * slot[:, :, None, :]
+        offset = offset + jnp.sum(m, axis=1, keepdims=True)
+
+    dispatch = combine > 0
+    total = jnp.float32(G * S * k)
+    load = frac_tokens + 1e-9
+    metrics = {
+        "moe_aux_loss": aux_loss,
+        "moe_dropped_frac": 1.0 - kept_tokens / total,
+        "moe_load_entropy": -jnp.sum(load * jnp.log(load)),
+    }
+    return combine, dispatch, aux_loss, metrics
+
+
+class StackedExperts(nn.Module):
+    """E parallel FFN experts as stacked weights — one batched einsum per
+    projection so every expert's GEMM tiles onto the MXU together.
+
+    Input/output: (E, N, M) with E sharded over the 'data' mesh axis
+    (expert parallelism) and the hidden dim optionally sharded over
+    'model' (tensor parallelism inside each expert, same layout rule as
+    the dense MLP: models/gpt2.py gpt2_tp_leaf_spec)."""
+    num_experts: int
+    d_model: int
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        E, M, F = self.num_experts, self.d_model, self.d_ff
+        w_in = self.param("w_in", nn.initializers.normal(0.02), (E, M, F),
+                          jnp.float32)
+        b_in = self.param("b_in", nn.initializers.zeros, (E, F), jnp.float32)
+        w_out = self.param("w_out", nn.initializers.normal(0.02), (E, F, M),
+                           jnp.float32)
+        b_out = self.param("b_out", nn.initializers.zeros, (E, M), jnp.float32)
+        h = jnp.einsum("enm,emf->enf", x, w_in.astype(self.dtype))
+        h = h + b_in.astype(self.dtype)[:, None, :]
+        h = mesh_lib.constrain(h, P(mesh_lib.DATA_AXIS, None,
+                                    mesh_lib.MODEL_AXIS))
+        h = nn.gelu(h, approximate=True)
+        y = jnp.einsum("enf,efm->enm", h, w_out.astype(self.dtype))
+        y = y + b_out.astype(self.dtype)[:, None, :]
+        return mesh_lib.constrain(y, P(mesh_lib.DATA_AXIS, None, None))
+
+
+class MoE(nn.Module):
+    """Sparsely-gated MoE FFN block (drop-in for a dense MLP).
+
+    x: (B, S, M) with B sharded over 'data'. Each batch row is a routing
+    group (static capacity is per row). Returns (B, S, M); the caller adds
+    the residual. The load-balance aux loss is sown into the 'losses'
+    collection as 'moe_aux_loss' (already scaled by aux_loss_coef) — loss
+    heads sum the collection into the objective.
+    """
+    num_experts: int
+    d_ff: int
+    k: int = 2
+    capacity_factor: float = 1.25
+    min_capacity: int = 4
+    aux_loss_coef: float = 0.01
+    router_jitter: float = 0.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        B, S, M = x.shape
+        E = self.num_experts
+        # router in fp32: tiny GEMM, and routing decisions are precision
+        # sensitive (flipping an argmax moves a whole token)
+        xr = x.astype(jnp.float32)
+        if train and self.router_jitter > 0:
+            xr = xr * jax.random.uniform(
+                self.make_rng("dropout"), xr.shape, jnp.float32,
+                1.0 - self.router_jitter, 1.0 + self.router_jitter)
+        logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="router")(xr)
+        combine, dispatch, aux, _ = top_k_gating(
+            logits, k=self.k, capacity_factor=self.capacity_factor,
+            min_capacity=self.min_capacity)
+        self.sow("losses", "moe_aux_loss",
+                 jnp.float32(self.aux_loss_coef) * aux,
+                 init_fn=lambda: jnp.float32(0.0),
+                 reduce_fn=lambda a, b: a + b)
+
+        # dispatch: token-sharded (B over 'data') -> expert-sharded (E over
+        # 'data'); the constraint flip is where GSPMD inserts the all_to_all
+        d = jnp.einsum("gsec,gsm->egcm", dispatch.astype(self.dtype), x)
+        C = d.shape[2]
+        d = mesh_lib.constrain(d, P(mesh_lib.DATA_AXIS, None, None, None))
+        y = StackedExperts(E, M, self.d_ff, dtype=self.dtype,
+                           name="experts")(d.reshape(E, B * C, M))
+        y = y.reshape(E, B, C, M)
+        # combine: expert-sharded -> token-sharded (the return all_to_all)
+        out = jnp.einsum("egcm,gsec->gsm", y, combine.astype(self.dtype))
+        return mesh_lib.constrain(out, P(mesh_lib.DATA_AXIS, None, None))
+
+
+def moe_leaf_spec(joined: str, leaf):
+    """Partition rule for MoE params (compose into a model's partition
+    spec walker): expert-stacked weights shard E over 'data' (expert
+    parallelism) and the FFN hidden dim over 'model' (TP inside the
+    expert); the router is replicated (every token scores every expert).
+
+    Returns None for non-MoE leaves so callers can fall through to their
+    dense rules."""
+    if "router" in joined:
+        return P()
+    if "experts" in joined:
+        if "w_in" in joined:
+            return P(mesh_lib.DATA_AXIS, None, mesh_lib.MODEL_AXIS)
+        if "w_out" in joined:
+            return P(mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS, None)
+        if "b_in" in joined:
+            return P(mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS)
+        if "b_out" in joined:
+            return P(mesh_lib.DATA_AXIS, None)
+        return P(mesh_lib.DATA_AXIS)
+    return None
+
+
+def sum_moe_losses(loss_collection) -> jnp.ndarray:
+    """Sum every sown 'moe_aux_loss' leaf in a mutable-collection dict."""
+    total = jnp.float32(0.0)
+    for leaf in jax.tree_util.tree_leaves(loss_collection):
+        total = total + jnp.sum(leaf)
+    return total

@@ -1,0 +1,230 @@
+"""MoE gating + expert-parallel layer tests (virtual 8-device CPU mesh).
+
+Mirrors the reference's kernel-parity test style (SURVEY §4: numeric parity
+vs a plain reference implementation) for the MoE extension.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.moe import (MoE, moe_capacity, sum_moe_losses,
+                               top_k_gating)
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+
+def test_capacity_static():
+    assert moe_capacity(128, 8, 2, 1.0) == 32
+    assert moe_capacity(128, 8, 1, 1.25) == 20
+    assert moe_capacity(4, 64, 1, 1.0) == 4          # min_capacity floor
+    assert moe_capacity(8, 2, 2, 100.0) == 16        # capped at S*k
+
+
+def test_top1_gating_routes_to_argmax():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.standard_normal((2, 16, 4)), jnp.float32)
+    combine, dispatch, _, _ = top_k_gating(logits, k=1, capacity=16,
+                                           normalize=False)
+    want = np.argmax(np.asarray(logits), -1)
+    got_expert = np.asarray(jnp.argmax(jnp.sum(combine, -1), -1))
+    np.testing.assert_array_equal(got_expert, want)
+    # gate weight equals the softmax prob of the chosen expert
+    probs = jax.nn.softmax(logits, -1)
+    np.testing.assert_allclose(
+        np.asarray(jnp.sum(combine, (2, 3))),
+        np.asarray(jnp.max(probs, -1)), rtol=1e-6)
+    # each (expert, slot) holds at most one token per group
+    per_slot = jnp.sum(dispatch.astype(jnp.int32), axis=1)  # (G, E, C)
+    assert int(jnp.max(per_slot)) <= 1
+
+
+def test_top2_combine_normalized():
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.standard_normal((1, 8, 4)), jnp.float32)
+    combine, _, _, _ = top_k_gating(logits, k=2, capacity=8)
+    # with ample capacity every token keeps both choices; normalized gates
+    # sum to 1 per token
+    np.testing.assert_allclose(np.asarray(jnp.sum(combine, (2, 3))),
+                               np.ones((1, 8)), rtol=1e-5)
+
+
+def test_capacity_overflow_drops_later_tokens():
+    # all 3 tokens route to expert 0; capacity 1 keeps only the first
+    logits = jnp.asarray([[[9.0, 0.0]] * 3], jnp.float32)
+    combine, dispatch, _, _ = top_k_gating(logits, k=1, capacity=1,
+                                           normalize=False)
+    kept = np.asarray(jnp.sum(dispatch, (2, 3)))
+    np.testing.assert_array_equal(kept, [[1, 0, 0]])
+
+
+def test_aux_loss_balanced_is_one():
+    # uniform router: fraction per expert = 1/E, mean prob = 1/E -> aux = 1
+    logits = jnp.zeros((2, 32, 8), jnp.float32)
+    # break argmax ties with tiny noise spread evenly across experts
+    noise = jnp.asarray(
+        np.eye(8)[np.arange(64) % 8].reshape(2, 32, 8) * 1e-3, jnp.float32)
+    _, _, aux, _ = top_k_gating(logits + noise, k=1, capacity=32)
+    assert abs(float(aux) - 1.0) < 1e-5
+
+
+def test_single_expert_matches_dense_ffn():
+    """E=1, k=1: gate prob is exactly 1, so MoE(x) == GELU-FFN(x)."""
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (2, 8, 16), jnp.float32)
+    moe = MoE(num_experts=1, d_ff=32, k=1, capacity_factor=1.0,
+              min_capacity=8, dtype=jnp.float32)
+    params = moe.init({"params": rng}, x, train=False)["params"]
+    y, _ = moe.apply({"params": params}, x, train=False,
+                     mutable=["losses"])
+    w_in = params["experts"]["w_in"][0]
+    b_in = params["experts"]["b_in"][0]
+    w_out = params["experts"]["w_out"][0]
+    b_out = params["experts"]["b_out"][0]
+    want = jax.nn.gelu(x @ w_in + b_in, approximate=True) @ w_out + b_out
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_moe_matches_per_token_expert_math():
+    """top-1, ample capacity: each token's output equals its chosen
+    expert's FFN applied to it, weighted by the (unnormalized) gate."""
+    rng = jax.random.PRNGKey(1)
+    E, B, S, M, F = 4, 2, 8, 16, 32
+    x = jax.random.normal(rng, (B, S, M), jnp.float32)
+    moe = MoE(num_experts=E, d_ff=F, k=1, capacity_factor=float(E),
+              min_capacity=S, dtype=jnp.float32)
+    params = moe.init({"params": rng}, x, train=False)["params"]
+    y, _ = moe.apply({"params": params}, x, train=False, mutable=["losses"])
+
+    logits = x @ params["router"]["kernel"]
+    probs = jax.nn.softmax(logits, -1)
+    choice = jnp.argmax(logits, -1)
+    ex = params["experts"]
+    for b in range(B):
+        for s in range(S):
+            e = int(choice[b, s])
+            t = x[b, s]
+            ff = jax.nn.gelu(t @ ex["w_in"][e] + ex["b_in"][e],
+                             approximate=True) @ ex["w_out"][e] \
+                + ex["b_out"][e]
+            want = float(probs[b, s, e]) * ff
+            np.testing.assert_allclose(np.asarray(y[b, s]),
+                                       np.asarray(want),
+                                       rtol=2e-4, atol=2e-4)
+
+
+def test_moe_grads_reach_all_params():
+    rng = jax.random.PRNGKey(2)
+    x = jax.random.normal(rng, (2, 16, 8), jnp.float32)
+    moe = MoE(num_experts=4, d_ff=16, k=2, dtype=jnp.float32)
+    params = moe.init({"params": rng}, x, train=False)["params"]
+
+    def loss(p):
+        y, col = moe.apply({"params": p}, x, train=False,
+                           mutable=["losses"])
+        return jnp.sum(y ** 2) + sum_moe_losses(col["losses"])
+
+    grads = jax.grad(loss)(params)
+    # router must get gradient (through combine weights and aux loss)
+    assert float(jnp.abs(grads["router"]["kernel"]).sum()) > 0
+    # with k=2 over 32 tokens and 4 experts, every expert sees tokens
+    gin = grads["experts"]["w_in"]
+    per_expert = jnp.sum(jnp.abs(gin), axis=(1, 2))
+    assert float(jnp.min(per_expert)) > 0
+
+
+@pytest.fixture
+def mesh8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest must provide 8 virtual CPU devices"
+    return mesh_lib.build_mesh({"pipe": 1, "data": 8, "model": 1},
+                               devices=devs[:8])
+
+
+def test_moe_sharded_matches_single_device(mesh8):
+    """Expert-parallel execution over dp=8 reproduces the unsharded
+    output — the all_to_all dispatch/combine is numerically transparent."""
+    rng = jax.random.PRNGKey(3)
+    E, B, S, M, F = 8, 8, 16, 16, 32
+    x = jax.random.normal(rng, (B, S, M), jnp.float32)
+    moe = MoE(num_experts=E, d_ff=F, k=2, dtype=jnp.float32)
+    params = moe.init({"params": rng}, x, train=False)["params"]
+    want, _ = moe.apply({"params": params}, x, train=False,
+                        mutable=["losses"])
+
+    with jax.set_mesh(mesh8):
+        spec = jax.tree_util.tree_map(lambda _: P(), params)
+        from deepspeed_tpu.moe import moe_leaf_spec
+
+        def pspec(path, leaf):
+            names = "/".join(
+                str(getattr(p, "key", getattr(p, "name", p)))
+                for p in path)
+            s = moe_leaf_spec(names, leaf)
+            return s if s is not None else P()
+
+        spec = jax.tree_util.tree_map_with_path(pspec, params)
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh8, s), spec,
+            is_leaf=lambda s: isinstance(s, P))
+        p_sh = jax.device_put(params, shardings)
+        x_sh = jax.device_put(x, NamedSharding(mesh8, P("data", None, None)))
+
+        @jax.jit
+        def run(p, xx):
+            y, _ = moe.apply({"params": p}, xx, train=False,
+                             mutable=["losses"])
+            return y
+
+        got = run(p_sh, x_sh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_moe_trains_on_engine(mesh8):
+    """Tiny GPT2-MoE through the full engine (ZeRO-2, dp=8): loss drops
+    and the expert weights are genuinely expert-sharded."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(vocab_size=128, n_positions=32, n_embd=32, n_layer=2,
+                     n_head=2, dtype=jnp.float32, loss_chunk_tokens=0,
+                     moe_num_experts=8, moe_top_k=2)
+    model = GPT2Model(cfg)
+    ds_config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"data": 8, "model": 1, "pipe": 1},
+        "steps_per_print": 10 ** 9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                               config_params=ds_config)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (1, 8, 32))
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    losses = [float(jax.device_get(engine.train_batch(batch=batch)))
+              for _ in range(15)]
+    assert losses[-1] < losses[0], losses
+
+    # h_1 is the MoE block (moe_layer_freq=2 -> odd layers); its expert
+    # stack must be sharded over the data axis, 1 expert per device
+    w_in = engine.state.params["h_1"]["moe"]["experts"]["w_in"]
+    shard_shape = w_in.sharding.shard_shape(w_in.shape)
+    assert shard_shape[0] == 1, (w_in.shape, shard_shape)
+
+
+def test_moe_rejects_scan_layers():
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(vocab_size=64, n_embd=16, n_layer=2, n_head=2,
+                     scan_layers=True, moe_num_experts=4)
+    model = GPT2Model(cfg)
+    with pytest.raises(AssertionError):
+        model.init(jax.random.PRNGKey(0),
+                   {"input_ids": np.zeros((1, 8), np.int32)})

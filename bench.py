@@ -210,15 +210,15 @@ def run_parent(args) -> int:
          "remat": 0, "timeout": 300},
     ]
     # fallbacks must only ever get SMALLER than the requested config — a
-    # 125m request that failed must not escalate to a 350m attempt
-    size_rank = ["gpt2-125m", "gpt2-350m", "gpt2-760m", "gpt2-1.5b",
-                 "bert-base", "bert-large"]
-
-    def rank(m):
-        return size_rank.index(m) if m in size_rank else len(size_rank)
+    # 125m request that failed must not escalate to a 350m attempt. The
+    # gpt2 ladder is incomparable with other families (bert etc.) and with
+    # unknown model names, so those get no fallbacks at all.
+    size_rank = ["gpt2-125m", "gpt2-350m", "gpt2-760m", "gpt2-1.5b"]
 
     def not_bigger(spec):
-        if rank(spec["model"]) > rank(args.model):
+        if args.model not in size_rank:
+            return False
+        if size_rank.index(spec["model"]) > size_rank.index(args.model):
             return False
         return spec["model"] != args.model or (
             spec["batch"] * spec["seq"] < args.batch * args.seq)
@@ -312,8 +312,10 @@ def main():
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--budget_s", type=int, default=1500,
                    help="wall-clock budget for the primary attempt")
-    p.add_argument("--init-retries", type=int, default=2)
-    p.add_argument("--retry-wait-s", type=int, default=20)
+    p.add_argument("--init-retries", type=int, default=4)
+    p.add_argument("--retry-wait-s", type=int, default=60,
+                   help="round-4: the axon tunnel was observed wedged for "
+                        ">30min stretches; patient retries beat fast ones")
     p.add_argument("--single-attempt", action="store_true")
     p.add_argument("--allow_cpu", type=int, default=0,
                    help="debug only: let the worker publish a CPU number")

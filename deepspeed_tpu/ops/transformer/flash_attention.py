@@ -9,6 +9,14 @@ attention memory is O(S) and the matmuls stay on the MXU.
 
 Forward saves only the per-row logsumexp; backward recomputes probabilities
 blockwise (two sweeps: dk/dv then dq) — the flash-attention v2 scheme.
+
+Attention dropout runs IN-KERNEL with a counter-based hash PRNG: the keep
+mask for (head, q, k) is a pure function of (seed, position), so backward
+regenerates the exact forward mask instead of saving an S x S byte mask to
+HBM (the reference's CUDA layer saves masks — dropout_kernels.cu +
+attn_dropout_checkpoint; SURVEY §2.7 maps that to counter-based PRNG on
+TPU). The hash is the murmur3 finalizer over plain uint32 ops, so the same
+code runs compiled on TPU and in interpreter mode on CPU.
 """
 import functools
 from typing import Optional
@@ -57,6 +65,32 @@ def _interpret_default() -> bool:
         return True
 
 
+def _dropout_keep(seed_ref, bh, q_start, k_start, block_q, block_k, s_k,
+                  rate):
+    """Keep-mask block for attention dropout: murmur3-finalizer hash of the
+    global (q, k) position, pre-mixed with (seed, batch*head). Deterministic
+    given the seed, so forward and both backward sweeps regenerate identical
+    masks from the positions alone."""
+    def mix(h):
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+        h = h * jnp.uint32(0xC2B2AE35)
+        return h ^ (h >> 16)
+
+    seed = seed_ref[0].astype(jnp.uint32) \
+        + jnp.uint32(0x9E3779B9) * jnp.uint32(bh)
+    rows = jax.lax.broadcasted_iota(jnp.uint32, (block_q, 1), 0)
+    cols = jax.lax.broadcasted_iota(jnp.uint32, (1, block_k), 1)
+    # q and k positions are mixed in two rounds rather than combined into a
+    # q*s_k + k linear index: the product overflows uint32 beyond ~64k seq
+    # (q rows 2^32/s_k apart would alias and share keep patterns)
+    rh = mix(seed ^ (jnp.uint32(q_start) + rows))           # (bq, 1)
+    h = mix(rh ^ (jnp.uint32(0x27D4EB2F) *
+                  (jnp.uint32(k_start) + cols)))            # (bq, bk)
+    return h >= jnp.uint32(min(rate, 0.9999) * 4294967296.0)
+
+
 def _apply_bias(s, bias_ref, bias_kind):
     """Additive attention bias inside a kernel block.
 
@@ -89,14 +123,18 @@ def _bias_specs(bias, bias_kind, num_heads, block_q, block_k, qmap, kmap):
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(*refs, scale, causal, bias_kind, block_q, block_k,
-                num_k_blocks):
+def _fwd_kernel(*refs, scale, causal, bias_kind, dropout_rate, s_k_total,
+                block_q, block_k, num_k_blocks):
+    seed_ref = None
+    if dropout_rate > 0.0:
+        seed_ref, *refs = refs
     if bias_kind == "none":
         q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
         bias_ref = None
     else:
         (q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
          m_scr, l_scr, acc_scr) = refs
+    bi = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -131,9 +169,19 @@ def _fwd_kernel(*refs, scale, causal, bias_kind, block_q, block_k,
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)                      # [bq, 1]
         p = jnp.exp(s - m_new)                               # [bq, bk] f32
+        # softmax denominator accumulates UNdropped p; dropout scales only
+        # the value accumulation (normalize-then-drop semantics, same as
+        # the reference applying dropout to softmax output)
         l_new = l_scr[:, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref, bi, q_start,
+                                 k_start, block_q, block_k, s_k_total,
+                                 dropout_rate)
+            p_acc = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        else:
+            p_acc = p
         acc_scr[:] = acc_scr[:] * alpha + _dot(
-            p.astype(v.dtype), v, ((1,), (0,)))
+            p_acc.astype(v.dtype), v, ((1,), (0,)))
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -146,8 +194,15 @@ def _fwd_kernel(*refs, scale, causal, bias_kind, block_q, block_k,
         lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
-def _flash_fwd(q, k, v, bias, *, scale, causal, bias_kind, num_heads,
-               block_q, block_k, interpret):
+def _seed_ops(seed, dropout_rate):
+    """(operands, in_specs) for the dropout seed — a scalar in SMEM."""
+    if dropout_rate <= 0.0:
+        return [], []
+    return [seed], [pl.BlockSpec(memory_space=pltpu.SMEM)]
+
+
+def _flash_fwd(q, k, v, bias, seed, *, scale, causal, bias_kind, num_heads,
+               dropout_rate, block_q, block_k, interpret):
     bh, s_q, d = q.shape
     s_k = k.shape[1]
     block_q = min(block_q, s_q)
@@ -157,7 +212,9 @@ def _flash_fwd(q, k, v, bias, *, scale, causal, bias_kind, num_heads,
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, bias_kind=bias_kind,
+        dropout_rate=dropout_rate, s_k_total=s_k,
         block_q=block_q, block_k=block_k, num_k_blocks=nk)
+    seed_ops, seed_specs = _seed_ops(seed, dropout_rate)
     bias_ops, bias_specs = _bias_specs(
         bias, bias_kind, num_heads, block_q, block_k,
         qmap=lambda i, j: i, kmap=lambda i, j: j)
@@ -165,7 +222,7 @@ def _flash_fwd(q, k, v, bias, *, scale, causal, bias_kind, num_heads,
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
-        in_specs=[
+        in_specs=seed_specs + [
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
@@ -186,15 +243,18 @@ def _flash_fwd(q, k, v, bias, *, scale, causal, bias_kind, num_heads,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, *bias_ops)
+    )(*seed_ops, q, k, v, *bias_ops)
     return out, lse[:, :, 0]
 
 
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
-def _bwd_dkdv_kernel(*refs, scale, causal, bias_kind, block_q, block_k,
-                     num_q_blocks):
+def _bwd_dkdv_kernel(*refs, scale, causal, bias_kind, dropout_rate,
+                     s_k_total, block_q, block_k, num_q_blocks):
+    seed_ref = None
+    if dropout_rate > 0.0:
+        seed_ref, *refs = refs
     if bias_kind == "none":
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dk_ref, dv_ref, dk_scr, dv_scr) = refs
@@ -202,6 +262,7 @@ def _bwd_dkdv_kernel(*refs, scale, causal, bias_kind, block_q, block_k,
     else:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
          dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    bi = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -232,8 +293,20 @@ def _bwd_dkdv_kernel(*refs, scale, causal, bias_kind, block_q, block_k,
             mask = (q_start + rows) >= (k_start + cols)
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)                                  # [bq, bk] f32
-        dv_scr[:] += _dot(p.astype(do.dtype), do, ((0,), (0,)))   # [bk, d]
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref, bi, q_start,
+                                 k_start, block_q, block_k, s_k_total,
+                                 dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_drop = jnp.where(keep, p * inv, 0.0)
+        else:
+            p_drop = p
+        dv_scr[:] += _dot(p_drop.astype(do.dtype), do, ((0,), (0,)))  # [bk,d]
         dp = _dot(do, v, ((1,), (1,)))                        # [bq, bk] f32
+        if dropout_rate > 0.0:
+            # dL/dP = keep/(1-r) * dO V^T; delta already equals
+            # rowsum(P_drop o dP) = rowsum(dO o O)
+            dp = jnp.where(keep, dp * inv, 0.0)
         ds = p * (dp - delta) * scale
         dk_scr[:] += _dot(ds.astype(q.dtype), q, ((0,), (0,)))   # [bk, d]
 
@@ -243,8 +316,11 @@ def _bwd_dkdv_kernel(*refs, scale, causal, bias_kind, block_q, block_k,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(*refs, scale, causal, bias_kind, block_q, block_k,
-                   num_k_blocks):
+def _bwd_dq_kernel(*refs, scale, causal, bias_kind, dropout_rate, s_k_total,
+                   block_q, block_k, num_k_blocks):
+    seed_ref = None
+    if dropout_rate > 0.0:
+        seed_ref, *refs = refs
     if bias_kind == "none":
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dq_ref, dq_scr) = refs
@@ -252,6 +328,7 @@ def _bwd_dq_kernel(*refs, scale, causal, bias_kind, block_q, block_k,
     else:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
          dq_ref, dq_scr) = refs
+    bi = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -282,6 +359,11 @@ def _bwd_dq_kernel(*refs, scale, causal, bias_kind, block_q, block_k,
             s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = _dot(do, v, ((1,), (1,)))
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref, bi, q_start,
+                                 k_start, block_q, block_k, s_k_total,
+                                 dropout_rate)
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
         ds = p * (dp - delta) * scale
         dq_scr[:] += _dot(ds.astype(k.dtype), k, ((1,), (0,)))
 
@@ -290,9 +372,9 @@ def _bwd_dq_kernel(*refs, scale, causal, bias_kind, block_q, block_k,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(res, g, *, scale, causal, bias_kind, num_heads, block_q,
-               block_k, interpret):
-    q, k, v, bias, out, lse = res
+def _flash_bwd(res, g, *, scale, causal, bias_kind, num_heads, dropout_rate,
+               block_q, block_k, interpret):
+    q, k, v, bias, seed, out, lse = res
     do = g
     bh, s_q, d = q.shape
     s_k = k.shape[1]
@@ -306,16 +388,18 @@ def _flash_bwd(res, g, *, scale, causal, bias_kind, num_heads, block_q,
     lse_w = jnp.broadcast_to(lse[:, :, None], (bh, s_q, 128)).astype(jnp.float32)
     delta_w = jnp.broadcast_to(delta[:, :, None], (bh, s_q, 128))
 
+    seed_ops, seed_specs = _seed_ops(seed, dropout_rate)
     # dkdv grid is (bh, k-block, q-block): bias maps transposed
     bias_ops, bias_specs = _bias_specs(
         bias, bias_kind, num_heads, block_q, block_k,
         qmap=lambda j, i: i, kmap=lambda j, i: j)
     dkdv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
-                          bias_kind=bias_kind,
+                          bias_kind=bias_kind, dropout_rate=dropout_rate,
+                          s_k_total=s_k,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq),
         grid=(bh, nk, nq),
-        in_specs=[
+        in_specs=seed_specs + [
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -338,7 +422,7 @@ def _flash_bwd(res, g, *, scale, causal, bias_kind, num_heads, block_q,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse_w, delta_w, *bias_ops)
+    )(*seed_ops, q, k, v, do, lse_w, delta_w, *bias_ops)
     dk, dv = dkdv
 
     bias_ops, bias_specs = _bias_specs(
@@ -346,10 +430,11 @@ def _flash_bwd(res, g, *, scale, causal, bias_kind, num_heads, block_q,
         qmap=lambda i, j: i, kmap=lambda i, j: j)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bias_kind=bias_kind,
+                          bias_kind=bias_kind, dropout_rate=dropout_rate,
+                          s_k_total=s_k,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk),
         grid=(bh, nq, nk),
-        in_specs=[
+        in_specs=seed_specs + [
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
@@ -363,49 +448,56 @@ def _flash_bwd(res, g, *, scale, causal, bias_kind, num_heads, block_q,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q, k, v, do, lse_w, delta_w, *bias_ops)
+    )(*seed_ops, q, k, v, do, lse_w, delta_w, *bias_ops)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
-def _flash_attention_3d(q, k, v, bias, scale, causal, bias_kind, num_heads,
-                        block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, bias, scale=scale, causal=causal,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11,
+                                                    12))
+def _flash_attention_3d(q, k, v, bias, seed, scale, causal, bias_kind,
+                        num_heads, dropout_rate, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, bias, seed, scale=scale, causal=causal,
                         bias_kind=bias_kind, num_heads=num_heads,
+                        dropout_rate=dropout_rate,
                         block_q=block_q, block_k=block_k, interpret=interpret)
     return out
 
 
-def _flash_3d_fwd(q, k, v, bias, scale, causal, bias_kind, num_heads,
-                  block_q, block_k, interpret):
-    out, lse = _flash_fwd(q, k, v, bias, scale=scale, causal=causal,
+def _flash_3d_fwd(q, k, v, bias, seed, scale, causal, bias_kind, num_heads,
+                  dropout_rate, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, bias, seed, scale=scale, causal=causal,
                           bias_kind=bias_kind, num_heads=num_heads,
+                          dropout_rate=dropout_rate,
                           block_q=block_q, block_k=block_k,
                           interpret=interpret)
-    return out, (q, k, v, bias, out, lse)
+    return out, (q, k, v, bias, seed, out, lse)
 
 
-def _flash_3d_bwd(scale, causal, bias_kind, num_heads, block_q, block_k,
-                  interpret, res, g):
+def _flash_3d_bwd(scale, causal, bias_kind, num_heads, dropout_rate, block_q,
+                  block_k, interpret, res, g):
     dq, dk, dv = _flash_bwd(res, g, scale=scale, causal=causal,
                             bias_kind=bias_kind, num_heads=num_heads,
+                            dropout_rate=dropout_rate,
                             block_q=block_q, block_k=block_k,
                             interpret=interpret)
     # bias is a constant additive mask (HF extended mask / key padding):
     # no gradient is produced for it (zeros keep the vjp total)
     dbias = None if res[3] is None else jnp.zeros_like(res[3])
-    return dq, dk, dv, dbias
+    dseed = None if res[4] is None else jnp.zeros_like(res[4])
+    return dq, dk, dv, dbias, dseed
 
 
-# nondiff args start at 4: scale, causal, bias_kind, num_heads, blocks, interpret
+# nondiff args start at 5: scale, causal, bias_kind, num_heads,
+# dropout_rate, blocks, interpret
 _flash_attention_3d.defvjp(_flash_3d_fwd, _flash_3d_bwd)
 
 
 def flash_attention(q, k, v, *, bias=None, causal: bool = False,
                     scale: Optional[float] = None,
+                    dropout_rate: float = 0.0, dropout_seed=None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: Optional[bool] = None):
@@ -416,6 +508,11 @@ def flash_attention(q, k, v, *, bias=None, causal: bool = False,
     Treated as a constant (no bias gradient). Differentiable in q/k/v
     (custom VJP with blockwise recomputation). On non-TPU backends runs in
     Pallas interpreter mode (slow; tests only).
+
+    dropout_rate/dropout_seed: in-kernel attention dropout. The seed (int
+    scalar or 0-d/1-elem int32 array, typically drawn per-step from the
+    engine's dropout rng) fully determines the keep mask; backward
+    regenerates it from positions, nothing is stored.
     """
     if interpret is None:
         interpret = _interpret_default()
@@ -451,9 +548,17 @@ def flash_attention(q, k, v, *, bias=None, causal: bool = False,
             bias3 = jnp.broadcast_to(
                 bias, (b, h, s_q, s_k)).astype(jnp.float32).reshape(
                     b * h, s_q, s_k)
+    dropout_rate = float(dropout_rate)
+    assert 0.0 <= dropout_rate < 1.0, f"bad dropout_rate {dropout_rate}"
+    seed1 = None
+    if dropout_rate > 0.0:
+        assert dropout_seed is not None, \
+            "dropout_rate > 0 requires dropout_seed"
+        seed1 = jnp.asarray(dropout_seed, jnp.int32).reshape(1)
     q3 = q.reshape(b * h, s_q, d)
     k3 = k.reshape(b * h, k.shape[2], d)
     v3 = v.reshape(b * h, v.shape[2], d)
-    out = _flash_attention_3d(q3, k3, v3, bias3, scale, causal, bias_kind,
-                              h, block_q, block_k, interpret)
+    out = _flash_attention_3d(q3, k3, v3, bias3, seed1, scale, causal,
+                              bias_kind, h, dropout_rate, block_q, block_k,
+                              interpret)
     return out.reshape(b, h, s_q, d)

@@ -23,11 +23,12 @@ def scaled_dot_product_attention(q, k, v, *, mask=None, bias=None, causal=False,
     when shapes allow (see deepspeed_tpu.ops.transformer.flash_attention).
     """
     if use_pallas is None:
-        use_pallas = _pallas_attention_ok(q, k, v, mask, bias, dropout_rate)
+        use_pallas = _pallas_attention_ok(q, k, v, mask, bias, dropout_rate,
+                                          dropout_rng)
     if use_pallas:
-        assert dropout_rate == 0.0, (
-            "pallas flash attention supports causal masking and additive "
-            "bias; dropout requires use_pallas=False (jnp path)")
+        assert dropout_rate == 0.0 or dropout_rng is not None, (
+            "pallas flash attention dropout needs a dropout_rng to derive "
+            "the in-kernel counter seed")
         from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
 
         if mask is not None:
@@ -37,7 +38,13 @@ def scaled_dot_product_attention(q, k, v, *, mask=None, bias=None, causal=False,
             mask_bias = jnp.where(mask, jnp.float32(0.0), jnp.float32(-1e30))
             bias = mask_bias if bias is None else bias + mask_bias
             mask = None
-        return flash_attention(q, k, v, bias=bias, causal=causal, scale=scale)
+        seed = None
+        if dropout_rate > 0.0:
+            # per-step scalar seed for the in-kernel counter-based PRNG
+            seed = jax.random.randint(dropout_rng, (1,), 0, 2 ** 31 - 1,
+                                      dtype=jnp.int32)
+        return flash_attention(q, k, v, bias=bias, causal=causal, scale=scale,
+                               dropout_rate=dropout_rate, dropout_seed=seed)
 
     head_dim = q.shape[-1]
     scale = (head_dim ** -0.5) if scale is None else scale
@@ -59,12 +66,13 @@ def scaled_dot_product_attention(q, k, v, *, mask=None, bias=None, causal=False,
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def _pallas_attention_ok(q, k, v, mask, bias, dropout_rate) -> bool:
-    # Pallas path: TPU backend, no dropout (causal, additive bias, and
-    # boolean keep-masks handled in-kernel), seq and head_dim aligned to
-    # MXU tiles. Bias/mask gradients are not produced (fine for constant
-    # masks — a learned bias needs use_pallas=False).
-    if dropout_rate > 0.0:
+def _pallas_attention_ok(q, k, v, mask, bias, dropout_rate,
+                         dropout_rng=None) -> bool:
+    # Pallas path: TPU backend, seq and head_dim aligned to MXU tiles;
+    # causal, additive bias, boolean keep-masks, and dropout (counter-based
+    # PRNG) are all handled in-kernel. Bias/mask gradients are not produced
+    # (fine for constant masks — a learned bias needs use_pallas=False).
+    if dropout_rate > 0.0 and dropout_rng is None:
         return False
 
     def key_padding_shaped(m):

@@ -1,0 +1,85 @@
+"""Ulysses-style sequence parallelism — all-to-all head/sequence resharding.
+
+The second sequence-parallel scheme (complementing ring attention,
+parallel/ring_attention.py): activations flow through the network sharded
+over the SEQUENCE dim, and for the attention op an all_to_all over the
+sequence axis re-shards them over the HEAD dim instead — each device then
+holds H/N heads with the FULL sequence, so any full-sequence attention
+kernel (the Pallas flash kernel, block-sparse, or plain jnp) runs unchanged
+per shard. A second all_to_all restores sequence sharding afterwards.
+Communication is 2 all_to_alls of the QKV/O tensors per attention call —
+O(B*S*E/N) per device, riding ICI.
+
+This is the DeepSpeed-Ulysses scheme (announced for the successor of the
+reference snapshot; the snapshot itself has NO sequence parallelism —
+SURVEY §2.9) built the TPU way: the resharding is expressed as sharding
+constraints and GSPMD emits the all_to_alls — no hand-written collective,
+and a single-device mesh degrades to a no-op.
+
+Requires num_heads % axis_size == 0 (classic Ulysses constraint; use ring
+attention when heads don't divide).
+"""
+import functools
+from typing import Callable, Optional
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel import mesh as mesh_lib
+
+
+def ulysses_attention(q, k, v, *, axis_name: str, mesh=None,
+                      attention_fn: Optional[Callable] = None, **attn_kw):
+    """Sequence-parallel attention over (B, H, S, D) tensors whose S dim is
+    sharded over `axis_name` (GSPMD view: pass GLOBAL arrays under jit).
+
+    attention_fn(q, k, v, **attn_kw) -> (B, H, S, D); defaults to
+    ops.transformer.functional.scaled_dot_product_attention (which
+    dispatches to the Pallas flash kernel on TPU — full-seq kernels work
+    because each shard sees the whole sequence after the reshard).
+
+    mesh: pass explicitly to bind the constraints anywhere; omit to use
+    the ambient engine mesh (model code inside an engine step).
+    """
+    if attention_fn is None:
+        from deepspeed_tpu.ops.transformer.functional import (
+            scaled_dot_product_attention)
+
+        attention_fn = scaled_dot_product_attention
+
+    seq_spec = P(None, None, axis_name, None)
+    head_spec = P(None, axis_name, None, None)
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        def constrain(x, spec):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, spec))
+    else:
+        constrain = mesh_lib.constrain
+    # seq-sharded -> head-sharded: GSPMD inserts the first all_to_all
+    q = constrain(q, head_spec)
+    k = constrain(k, head_spec)
+    v = constrain(v, head_spec)
+    out = attention_fn(q, k, v, **attn_kw)
+    # head-sharded -> seq-sharded: the return all_to_all
+    return constrain(out, seq_spec)
+
+
+def make_ulysses_attention(mesh, axis_name: str, causal: bool = True,
+                           scale: Optional[float] = None,
+                           attention_fn: Optional[Callable] = None):
+    """Jit-wrapped Ulysses attention over full (B, H, S, D) arrays with the
+    sequence dim sharded over `axis_name` — API twin of
+    make_ring_attention. num_heads must be divisible by the axis size."""
+
+    def fn(q, k, v):
+        assert q.shape[1] % mesh.shape[axis_name] == 0, (
+            f"ulysses needs heads ({q.shape[1]}) divisible by axis "
+            f"'{axis_name}' size ({mesh.shape[axis_name]}); use ring "
+            f"attention otherwise")
+        return ulysses_attention(q, k, v, axis_name=axis_name, mesh=mesh,
+                                 attention_fn=attention_fn,
+                                 causal=causal, scale=scale)
+
+    return fn

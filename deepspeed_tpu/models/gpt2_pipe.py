@@ -36,10 +36,11 @@ class GPT2Embed(nn.Module):
 
 class GPT2BlockLayer(nn.Module):
     config: GPT2Config
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        return Block(self.config, name="block")(x, train)
+        return Block(self.config, use_moe=self.use_moe, name="block")(x, train)
 
 
 class GPT2FinalNorm(nn.Module):
@@ -73,15 +74,16 @@ def _tp_spec(params):
 def gpt2_pipeline_module(config: GPT2Config, partition_method="parameters",
                          activation_checkpoint_interval=0):
     """Build the LayerSpec pipeline for a GPT-2 config (TP specs included —
-    with mesh model>1 this is the 3D PP x TP x DP configuration)."""
-    # MoE blocks sow an aux loss the pipeline's per-stage forward doesn't
-    # collect yet; refuse rather than silently train an all-dense model
-    assert not config.moe_num_experts, \
-        "moe_num_experts > 0 is not supported by the pipeline engine yet"
+    with mesh model>1 this is the 3D PP x TP x DP configuration). MoE
+    configs (moe_num_experts > 0) alternate dense/MoE blocks exactly like
+    the monolithic GPT2Model; each MoE block's load-balance loss is sown
+    stage-locally and the PipelineEngine folds it into the objective."""
     specs = [TiedLayerSpec("embed", GPT2Embed, config,
                            partition_spec=_tp_spec)]
-    for _ in range(config.n_layer):
-        specs.append(LayerSpec(GPT2BlockLayer, config,
+    for i in range(config.n_layer):
+        use_moe = bool(config.moe_num_experts) \
+            and i % config.moe_layer_freq == config.moe_layer_freq - 1
+        specs.append(LayerSpec(GPT2BlockLayer, config, use_moe=use_moe,
                                partition_spec=_tp_spec))
     specs.append(LayerSpec(GPT2FinalNorm, config))
     specs.append(TiedLayerSpec("embed", GPT2Embed, config,

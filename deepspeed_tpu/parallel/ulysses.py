@@ -19,7 +19,6 @@ and a single-device mesh degrades to a no-op.
 Requires num_heads % axis_size == 0 (classic Ulysses constraint; use ring
 attention when heads don't divide).
 """
-import functools
 from typing import Callable, Optional
 
 import jax

@@ -255,6 +255,8 @@ class PipelineEngine(DeepSpeedEngine):
         S = self.num_stages
         gas = self.micro_batches
         loss_fn = module.loss_fn
+        # does any layer sow aux losses (MoE)? decided by module.init()
+        self._module_has_aux = any(l.has_losses for l in module._layers)
 
         self._stage_jits = []
         for s in range(S):
@@ -263,10 +265,17 @@ class PipelineEngine(DeepSpeedEngine):
             def fwd(params, x, rng, s=s):
                 return module.forward_stage(params, x, s, rng, train=True)
 
+            def fwd_aux(params, x, rng, s=s):
+                # stage forward + stage-local sown aux losses (MoE load
+                # balance): backward adds them to the objective directly
+                return module.forward_stage(params, x, s, rng, train=True,
+                                            return_aux=True)
+
             def fwd_loss(params, x, rng, batch, s=s):
-                out = module.forward_stage(params, x, s, rng, train=True)
+                out, aux = module.forward_stage(params, x, s, rng,
+                                                train=True, return_aux=True)
                 loss, _ = loss_fn(out, batch)
-                return loss
+                return loss, aux
 
             rep_sh, zero_sh, opt_sh = self._stage_shardings[s]
 
@@ -287,8 +296,15 @@ class PipelineEngine(DeepSpeedEngine):
             def bwd_last(params, accum, x, rng, batch, scale,
                          fwd_loss=fwd_loss, accum_add=accum_add):
                 def scaled(params, x):
-                    loss = fwd_loss(params, x, rng, batch)
-                    return loss.astype(jnp.float32) * scale / gas, loss
+                    loss, aux = fwd_loss(params, x, rng, batch)
+                    # reported loss includes the stage-local aux term so the
+                    # two executors of a PipelineModule (this engine and the
+                    # sequential base-engine path via module.loss) agree.
+                    # Mid-stage aux terms enter gradients only — a truly
+                    # global reported objective would need an extra host
+                    # reduction per micro-batch.
+                    with_aux = loss.astype(jnp.float32) + aux
+                    return with_aux * scale / gas, with_aux
 
                 # integer x (token ids reaching the last stage when pipe=1)
                 # is not differentiable and its grad is never sent anywhere
@@ -302,11 +318,20 @@ class PipelineEngine(DeepSpeedEngine):
                     gx = jnp.zeros((), jnp.float32)
                 return accum_add(accum, gp), gx, loss
 
-            def bwd_mid(params, accum, x, rng, gy, fwd=fwd,
+            def bwd_mid(params, accum, x, rng, gy, scale, fwd_aux=fwd_aux,
                         accum_add=accum_add):
-                _, vjp = jax.vjp(lambda p, x: fwd(p, x, rng), params, x)
-                gp, gx = vjp(gy)
-                return accum_add(accum, gp), gx
+                def f(p, x):
+                    y, aux = fwd_aux(p, x, rng)
+                    return y, jnp.asarray(aux, jnp.float32)
+
+                (_, aux), vjp = jax.vjp(f, params, x)
+                # aux cotangent scale/gas: the stage-local aux losses enter
+                # the objective with the same loss scaling as the last
+                # stage's loss term
+                gp, gx = vjp((gy, (scale / gas).astype(jnp.float32)))
+                # raw aux returned so train_batch can report the FULL
+                # objective (last-stage loss + every stage's aux)
+                return accum_add(accum, gp), gx, aux
 
             def sqnorm(accum):
                 total = jnp.float32(0.0)
@@ -371,6 +396,7 @@ class PipelineEngine(DeepSpeedEngine):
                 "eval_loss": jax.jit(eval_loss) if is_last else None,
                 "mean_loss": jax.jit(
                     lambda ls: jnp.stack(ls).mean()) if is_last else None,
+                "mean_scalar": jax.jit(lambda ls: jnp.stack(ls).mean()),
                 "mesh": submesh,
             }
             self._stage_jits.append(jits)
@@ -414,7 +440,7 @@ class PipelineEngine(DeepSpeedEngine):
         self._ensure_pipe_state(micros[0])
         self.tput_timer.start()
 
-        losses = self._exec_train_schedule(micros)
+        losses, mid_auxes = self._exec_train_schedule(micros)
 
         # --- optimizer step (host-coordinated across stages) -----------
         lr = self._advance_lr()
@@ -466,6 +492,14 @@ class PipelineEngine(DeepSpeedEngine):
         with jax.set_mesh(self._submeshes[-1]):
             loss = float(jax.device_get(
                 self._stage_jits[-1]["mean_loss"](losses)))
+        # mid-stage aux losses (MoE load balance) join the reported
+        # objective so train_batch returns the same number regardless of
+        # stage count (the last stage's own aux is already inside `loss`)
+        for s, auxes in enumerate(mid_auxes):
+            if auxes:
+                with jax.set_mesh(self._submeshes[s]):
+                    loss += float(jax.device_get(
+                        self._stage_jits[s]["mean_scalar"](auxes)))
         self._last_loss = loss
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps)
@@ -527,6 +561,7 @@ class PipelineEngine(DeepSpeedEngine):
         act_q = [deque() for _ in range(S)]   # edge s-1 -> s
         grad_q = [deque() for _ in range(S)]  # edge s+1 -> s
         losses = []
+        mid_auxes = [[] for _ in range(S)]    # per-micro aux, mid stages
         base_rng = jax.random.fold_in(self._pipe_rng, self.global_steps)
         micro_rngs = [jax.random.fold_in(base_rng, i)
                       for i in range(self.micro_batches)]
@@ -587,9 +622,12 @@ class PipelineEngine(DeepSpeedEngine):
                                     np.float32(self._pipe_scaler.cur_scale))
                                 losses.append(loss)
                             else:
-                                new_accum, gx = jits["bwd_mid"](
+                                new_accum, gx, aux = jits["bwd_mid"](
                                     st.params, st.accum, in_act[s][buf], rng,
-                                    in_grad[s][buf])
+                                    in_grad[s][buf],
+                                    np.float32(self._pipe_scaler.cur_scale))
+                                if self._module_has_aux:
+                                    mid_auxes[s].append(aux)
                             self.stage_states[s] = st._replace(
                                 accum=new_accum)
                             st = self.stage_states[s]
@@ -609,7 +647,7 @@ class PipelineEngine(DeepSpeedEngine):
                         pass
                     else:  # pragma: no cover
                         raise AssertionError(f"unknown instruction {cmd}")
-        return losses
+        return losses, mid_auxes
 
     def _reduce_tied_grads(self):
         """Sum tied-param grad accumulators across tie-group stages and

@@ -89,6 +89,7 @@ class _Layer:
         # inspect once instead of catching TypeError per call — a retry
         # would silently swallow genuine TypeErrors from the train path
         self.accepts_train = False
+        self.has_losses = False   # set by init(): layer sows aux losses
         if self.is_flax:
             try:
                 sig = inspect.signature(type(obj).__call__)
@@ -96,8 +97,10 @@ class _Layer:
             except (TypeError, ValueError):  # pragma: no cover
                 pass
 
-    def _flax_apply(self, params, x, rng, train):
+    def _flax_apply(self, params, x, rng, train, mutable=None):
         kwargs = {"train": train} if self.accepts_train else {}
+        if mutable is not None:
+            kwargs["mutable"] = mutable
         return self.obj.apply({"params": params}, x,
                               rngs={"dropout": rng}, **kwargs)
 
@@ -107,6 +110,10 @@ class _Layer:
             variables = self.obj.init({"params": rng, "dropout": rng}, x,
                                       **kwargs)
             params = variables.get("params", {})
+            # does this layer sow auxiliary losses (MoE load balance)?
+            # Decided once here so dense layers never pay the mutable-apply
+            # path and aux stays a Python 0.0 through dense pipelines
+            self.has_losses = bool(variables.get("losses"))
             return params, self._flax_apply(params, x, rng, train=False)
         # stateless callable
         return None, self.obj(x)
@@ -117,6 +124,19 @@ class _Layer:
         if self.is_flax:
             return self._flax_apply(params, x, rng, train)
         return self.obj(x)
+
+    def apply_aux(self, params, x, rng, train):
+        """apply + this layer's sown auxiliary loss (flax 'losses'
+        collection — e.g. the MoE load-balance term), Python 0.0 when the
+        layer sows none (decided at init)."""
+        if self.forward_fn is not None or not self.is_flax \
+                or not getattr(self, "has_losses", False):
+            return self.apply(params, x, rng, train), 0.0
+        out, col = self._flax_apply(params, x, rng, train,
+                                    mutable=["losses"])
+        from deepspeed_tpu.moe import sum_moe_losses
+
+        return out, sum_moe_losses(col.get("losses", {}))
 
 
 class PipelineModule:
@@ -213,30 +233,52 @@ class PipelineModule:
 
     def loss(self, params, batch, rng, train=True):
         assert self.loss_fn is not None, "PipelineModule needs loss_fn to train"
-        out = self.forward_full(params, batch, rng, train)
-        return self.loss_fn(out, batch)
+        out, aux = self.forward_full(params, batch, rng, train,
+                                     return_aux=True)
+        loss, metrics = self.loss_fn(out, batch)
+        if train and not isinstance(aux, float):
+            # layer-sown auxiliary losses (MoE load balance) join the
+            # training objective; eval loss stays comparable to dense
+            loss = loss + aux
+            metrics = dict(metrics, aux_loss=aux, loss=loss)
+        return loss, metrics
 
-    def forward_full(self, params, batch, rng, train):
+    def forward_full(self, params, batch, rng, train, return_aux=False):
         """Sequential (non-pipelined) forward through all layers, with
         activation checkpointing every N layers when configured."""
         import jax
 
         x = self.input_fn(batch)
+        aux = 0.0
         interval = self.activation_checkpoint_interval
         if interval and train:
             for start in range(0, len(self._layers), interval):
                 seg = self._layers[start:start + interval]
+                # segments without sown losses keep the plain (x-only)
+                # remat body so a dense model's aux stays the Python 0.0
+                # sentinel (jax.checkpoint would trace a constant into an
+                # Array and fake an aux term downstream)
+                if any(l.has_losses for l in seg):
+                    def run_aux(x, seg=seg):
+                        return self._apply_range(params, x, rng, train, seg,
+                                                 collect_aux=True)
 
-                def run(x, seg=seg):
-                    return self._apply_range(params, x, rng, train, seg)
+                    x, seg_aux = jax.checkpoint(run_aux)(x)
+                    aux = aux + seg_aux
+                else:
+                    def run(x, seg=seg):
+                        return self._apply_range(params, x, rng, train, seg)
 
-                x = jax.checkpoint(run)(x)
-            return x
-        return self._apply_range(params, x, rng, train, self._layers)
+                    x = jax.checkpoint(run)(x)
+        else:
+            x, aux = self._apply_range(params, x, rng, train, self._layers,
+                                       collect_aux=True)
+        return (x, aux) if return_aux else x
 
-    def _apply_range(self, params, x, rng, train, layers):
+    def _apply_range(self, params, x, rng, train, layers, collect_aux=False):
         import jax
 
+        aux = 0.0
         for layer in layers:
             # dropout keys fold in layer.index unconditionally: identical
             # same-shaped layers must not share dropout masks (seed_layers
@@ -244,14 +286,24 @@ class PipelineModule:
             # where torch's global RNG advances per layer regardless)
             lrng = jax.random.fold_in(rng, layer.index)
             p = params[layer.param_key] if layer.param_key is not None else None
-            x = layer.apply(p, x, lrng, train)
-        return x
+            if collect_aux:
+                x, layer_aux = layer.apply_aux(p, x, lrng, train)
+                aux = aux + layer_aux
+            else:
+                x = layer.apply(p, x, lrng, train)
+        return (x, aux) if collect_aux else x
 
-    def forward_stage(self, params, x, stage_id, rng, train, num_stages=None):
-        """Apply this stage's layer range to x (PipelineEngine hot path)."""
+    def forward_stage(self, params, x, stage_id, rng, train, num_stages=None,
+                      return_aux=False):
+        """Apply this stage's layer range to x (PipelineEngine hot path).
+        return_aux: also return the stage-local sum of sown auxiliary
+        losses (the PipelineEngine's backward adds them to the objective —
+        an aux loss at stage k contributes a DIRECT gradient at stage k,
+        it never flows through the activation cotangents)."""
         start, stop = self.stage_bounds(stage_id, num_stages)
         return self._apply_range(params, x, rng, train,
-                                 self._layers[start:stop])
+                                 self._layers[start:stop],
+                                 collect_aux=return_aux)
 
     # ------------------------------------------------------------------
     # partitioning (reference module.py:348-403)

@@ -91,10 +91,21 @@ class CausalSelfAttention(nn.Module):
 
         q, k, v = heads(q), heads(k), heads(v)
         drop_rng = self.make_rng("dropout") if (train and cfg.dropout > 0) else None
+        # Ulysses sequence parallelism (parallel/ulysses.py): with a
+        # nontrivial 'seq' axis these constraints flip the sequence dim to
+        # full and shard heads over ('model','seq') instead (GSPMD
+        # all_to_all) so the attention kernel sees the whole sequence.
+        # Every dim names its axes — a partial spec would pin the batch's
+        # 'data' and the heads' 'model' sharding to replicated.
+        head_sp = P("data", ("model", "seq"), None, None)
+        q = mesh_lib.constrain(q, head_sp)
+        k = mesh_lib.constrain(k, head_sp)
+        v = mesh_lib.constrain(v, head_sp)
         y = scaled_dot_product_attention(
             q, k, v, causal=True, dropout_rng=drop_rng,
             dropout_rate=cfg.dropout if train else 0.0,
             use_pallas=cfg.use_pallas_attention)
+        y = mesh_lib.constrain(y, P("data", "model", "seq", None))
         y = y.transpose(0, 2, 1, 3).reshape(B, S, E)
         y = nn.Dense(E, dtype=cfg.dtype, name="c_proj")(y)
         if train and cfg.dropout > 0:
@@ -140,8 +151,9 @@ class Block(nn.Module):
         else:
             ffn = MLP(cfg, name="mlp")
         x = x + ffn(h, train)
-        # keep activations sharded batch-over-data as blocks stack
-        x = mesh_lib.constrain(x, P("data", None, None))
+        # keep activations sharded batch-over-data (and sequence-over-seq
+        # under sequence parallelism) as blocks stack
+        x = mesh_lib.constrain(x, P("data", "seq", None))
         return x
 
 

@@ -16,8 +16,11 @@ import numpy as np
 
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
-AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, MODEL_AXIS)
+# seq sits between data and model: sequence-parallel all_to_alls ride
+# faster links than data-parallel gradient reductions, TP innermost still
+AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 
 def resolve_mesh_shape(mesh_shape: dict, n_devices: int,
@@ -32,6 +35,7 @@ def resolve_mesh_shape(mesh_shape: dict, n_devices: int,
     """
     shape = {PIPE_AXIS: mesh_shape.get(PIPE_AXIS, 1),
              DATA_AXIS: mesh_shape.get(DATA_AXIS, -1),
+             SEQ_AXIS: mesh_shape.get(SEQ_AXIS, 1),
              MODEL_AXIS: mesh_shape.get(MODEL_AXIS, 1)}
     fixed = 1
     free_axes = [a for a, s in shape.items() if s == -1]
@@ -43,7 +47,8 @@ def resolve_mesh_shape(mesh_shape: dict, n_devices: int,
         assert n_devices % fixed == 0, \
             f"{n_devices} devices not divisible by fixed axes product {fixed}"
         shape[free_axes[0]] = n_devices // fixed
-    total = shape[PIPE_AXIS] * shape[DATA_AXIS] * shape[MODEL_AXIS]
+    total = shape[PIPE_AXIS] * shape[DATA_AXIS] * shape[SEQ_AXIS] \
+        * shape[MODEL_AXIS]
     if allow_partial:
         assert total <= n_devices, \
             f"mesh {shape} needs {total} devices but {n_devices} available"
@@ -73,7 +78,8 @@ def build_mesh(mesh_shape: Optional[dict] = None, devices=None):
     else:
         allow_partial = True
     shape = resolve_mesh_shape(mesh_shape, len(devices), allow_partial)
-    total = shape[PIPE_AXIS] * shape[DATA_AXIS] * shape[MODEL_AXIS]
+    total = shape[PIPE_AXIS] * shape[DATA_AXIS] * shape[SEQ_AXIS] \
+        * shape[MODEL_AXIS]
     if total < len(devices):
         from deepspeed_tpu.utils.logging import logger
 
@@ -82,7 +88,8 @@ def build_mesh(mesh_shape: Optional[dict] = None, devices=None):
             f"{len(devices) - total} idle (intended for tests/partial "
             f"slices; check the config's mesh axes if not)")
     dev_array = np.asarray(devices[:total]).reshape(
-        shape[PIPE_AXIS], shape[DATA_AXIS], shape[MODEL_AXIS])
+        shape[PIPE_AXIS], shape[DATA_AXIS], shape[SEQ_AXIS],
+        shape[MODEL_AXIS])
     return Mesh(dev_array, AXIS_ORDER)
 
 
@@ -147,6 +154,10 @@ def mp_size(mesh) -> int:
 
 def pp_size(mesh) -> int:
     return mesh.shape[PIPE_AXIS]
+
+
+def sp_size(mesh) -> int:
+    return mesh.shape.get(SEQ_AXIS, 1)
 
 
 def zero_merge_spec(spec, leaf, dp: int):

@@ -265,6 +265,7 @@ def get_mesh_shape(param_dict):
     shape = {
         MESH_PIPE_AXIS: d.get(MESH_PIPE_AXIS, 1),
         MESH_DATA_AXIS: d.get(MESH_DATA_AXIS, -1),
+        MESH_SEQ_AXIS: d.get(MESH_SEQ_AXIS, 1),
         MESH_MODEL_AXIS: d.get(MESH_MODEL_AXIS, 1),
     }
     if d.get(MESH_ALLOW_PARTIAL, False):

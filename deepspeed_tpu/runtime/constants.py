@@ -163,6 +163,7 @@ MESH = "mesh"                       # {"data": -1, "model": 1, "pipe": 1}
 MESH_DATA_AXIS = "data"
 MESH_MODEL_AXIS = "model"
 MESH_PIPE_AXIS = "pipe"
+MESH_SEQ_AXIS = "seq"
 MESH_ALLOW_PARTIAL = "allow_partial"   # opt-in: mesh may cover a device subset
 
 #############################################

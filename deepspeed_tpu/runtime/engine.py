@@ -110,6 +110,7 @@ class DeepSpeedEngine:
         self.mesh = mesh_lib.build_mesh(get_mesh_shape(raw_dict))
         self.dp_world_size = mesh_lib.dp_size(self.mesh)
         self.mp_world_size = mesh_lib.mp_size(self.mesh)
+        self.sp_world_size = mesh_lib.sp_size(self.mesh)
         self._config = DeepSpeedConfig(raw_dict, world_size=self.dp_world_size)
         self._config.print_enabled = False
 
@@ -704,7 +705,11 @@ class DeepSpeedEngine:
                     f"data-parallel degree; feed "
                     f"train_micro_batch_size_per_gpu*local_dp = "
                     f"{self.train_micro_batch_size_per_gpu() * self.local_dp_size} rows")
-            sh = NamedSharding(mesh, P(*(["data"] + [None] * (x.ndim - 1))))
+            # dim1 (sequence) shards over 'seq' when a seq axis exists:
+            # Ulysses-style sequence parallelism (parallel/ulysses.py)
+            seq = ["seq"] if self.sp_world_size > 1 and x.ndim >= 2 else []
+            sh = NamedSharding(mesh, P(*(["data"] + seq
+                                         + [None] * (x.ndim - 1 - len(seq)))))
             if jax.process_count() > 1:
                 return jax.make_array_from_process_local_data(sh, x)
             return jax.device_put(x, sh)
@@ -1625,7 +1630,9 @@ class DeepSpeedEngine:
 
         def put(x):
             x = np.asarray(x)
-            sh = NamedSharding(mesh, P(*([None, "data"] + [None] * (x.ndim - 2))))
+            seq = ["seq"] if self.sp_world_size > 1 and x.ndim >= 3 else []
+            sh = NamedSharding(mesh, P(*([None, "data"] + seq
+                                         + [None] * (x.ndim - 2 - len(seq)))))
             if jax.process_count() > 1:
                 return jax.make_array_from_process_local_data(sh, x)
             return jax.device_put(x, sh)

@@ -73,11 +73,12 @@ class PipelineEngine(DeepSpeedEngine):
             num_dp=self.dp_world_size)
         self.grid = PipelineParallelGrid(topology=topo, rank=0)
 
-        # one submesh per stage: mesh.devices is (pipe, data, model)
+        # one submesh per stage: mesh.devices is (pipe, data, seq, model)
         self._submeshes = []
         for s in range(self.num_stages):
             self._submeshes.append(
-                jax.sharding.Mesh(self.mesh.devices[s], ("data", "model")))
+                jax.sharding.Mesh(self.mesh.devices[s],
+                                  ("data", "seq", "model")))
 
         self.stage_states = None          # list[StageState], lazy
         self._stage_shardings = None
@@ -394,8 +395,6 @@ class PipelineEngine(DeepSpeedEngine):
                 "apply_step": jax.jit(apply_step, donate_argnums=(0,)),
                 "eval_fwd": jax.jit(eval_fwd),
                 "eval_loss": jax.jit(eval_loss) if is_last else None,
-                "mean_loss": jax.jit(
-                    lambda ls: jnp.stack(ls).mean()) if is_last else None,
                 "mean_scalar": jax.jit(lambda ls: jnp.stack(ls).mean()),
                 "mesh": submesh,
             }
@@ -491,7 +490,7 @@ class PipelineEngine(DeepSpeedEngine):
         # one reduction + one transfer instead of gas scalar fetches
         with jax.set_mesh(self._submeshes[-1]):
             loss = float(jax.device_get(
-                self._stage_jits[-1]["mean_loss"](losses)))
+                self._stage_jits[-1]["mean_scalar"](losses)))
         # mid-stage aux losses (MoE load balance) join the reported
         # objective so train_batch returns the same number regardless of
         # stage count (the last stage's own aux is already inside `loss`)

@@ -136,10 +136,23 @@ class _EncoderBody(nn.Module):
 
         drop_rng = self.make_rng("dropout") \
             if (train and cfg.attn_dropout_ratio > 0) else None
+        # Ulysses sequence parallelism: under a nontrivial 'seq' mesh axis
+        # the heads dim picks up the seq shard and the sequence dim goes
+        # full (GSPMD all_to_all) — same flip as models/gpt2.py; every dim
+        # names its axes so data/model sharding is preserved
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.parallel import mesh as mesh_lib
+
+        head_sp = P("data", ("model", "seq"), None, None)
+        qh = mesh_lib.constrain(heads(q), head_sp)
+        kh = mesh_lib.constrain(heads(k), head_sp)
+        vh = mesh_lib.constrain(heads(v), head_sp)
         ctx = scaled_dot_product_attention(
-            heads(q), heads(k), heads(v), causal=False, bias=attention_mask,
+            qh, kh, vh, causal=False, bias=attention_mask,
             dropout_rng=drop_rng,
             dropout_rate=cfg.attn_dropout_ratio if train else 0.0)
+        ctx = mesh_lib.constrain(ctx, P("data", "model", "seq", None))
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, E)
         attn_out = dense(E, "attn_out", out_std)(ctx)
         if train and cfg.hidden_dropout_ratio > 0:

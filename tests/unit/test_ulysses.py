@@ -128,3 +128,40 @@ def test_engine_seq_axis_shards_batch():
         {"input_ids": np.zeros((2, 32), np.int32)})["input_ids"]
     assert dev.sharding.shard_shape(dev.shape) == (2, 8), \
         dev.sharding.shard_shape(dev.shape)
+
+
+def test_bert_fused_layer_seq_axis_parity():
+    """The fused transformer layer (BERT path) under dp x sp reproduces
+    plain dp — exercises the Ulysses constraints in transformer.py."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining
+
+    def run(mesh_cfg):
+        cfg = BertConfig(vocab_size=128, hidden_size=32,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         intermediate_size=64, max_position_embeddings=64,
+                         dtype=jnp.float32, hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=BertForPreTraining(cfg), config_params={
+                "train_batch_size": 4,
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1},
+                "mesh": dict(mesh_cfg, allow_partial=True),
+                "steps_per_print": 10 ** 9,
+            })
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 128, (1, 4, 64))
+        labels = np.where(rng.random((1, 4, 64)) < 0.2, ids, -100)
+        batch = {"input_ids": ids,
+                 "attention_mask": np.ones((1, 4, 64), np.int32),
+                 "masked_lm_labels": labels}
+        return [float(jax.device_get(engine.train_batch(batch=batch)))
+                for _ in range(4)]
+
+    base = run({"data": 2, "model": 1, "pipe": 1})
+    sp = run({"data": 2, "seq": 4, "model": 1, "pipe": 1})
+    assert all(np.isfinite(base)), base
+    np.testing.assert_allclose(base, sp, rtol=2e-4)

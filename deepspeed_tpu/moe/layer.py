@@ -1,0 +1,26 @@
+"""DeepSpeed-style MoE entry point.
+
+Later DeepSpeed exposes ``deepspeed.moe.layer.MoE(hidden_size, expert,
+num_experts, k, capacity_factor, ...)``; users coming from there find the
+equivalent here. The TPU-native layer is flax (experts are stacked weight
+tensors, not wrapped submodules), so ``hidden_size``/``expert`` map onto
+the module fields instead of wrapping a torch module.
+"""
+from deepspeed_tpu.moe.sharded_moe import MoE as _MoE
+
+
+def MoE(hidden_size: int, num_experts: int = 1, k: int = 1,
+        capacity_factor: float = 1.0, min_capacity: int = 4,
+        expert_intermediate_size: int = 0, aux_loss_coef: float = 0.01,
+        noisy_gate_policy: str = None, **kw):
+    """Build the flax MoE layer with DeepSpeed-MoE argument names.
+
+    noisy_gate_policy: None or 'Jitter' (maps to router_jitter=0.01;
+    DeepSpeed's 'RSample' has no equivalent here).
+    """
+    jitter = 0.01 if noisy_gate_policy == "Jitter" else 0.0
+    return _MoE(num_experts=num_experts,
+                d_ff=expert_intermediate_size or 4 * hidden_size,
+                k=k, capacity_factor=capacity_factor,
+                min_capacity=min_capacity, aux_loss_coef=aux_loss_coef,
+                router_jitter=jitter, **kw)

@@ -559,9 +559,13 @@ class DeepSpeedEngine:
             host_dev = jax.local_devices()[0]
         with jax.default_device(host_dev):
             params_f32 = self.module.init(init_rng, batch)
+        # np.array(copy=True): device_get of an already-fp32 CPU array is a
+        # zero-copy READ-ONLY view, and the host Adam updates masters in
+        # place (bf16/fp16 configs hid this — their dtype cast forced a
+        # writable copy; fp32 offload crashed)
         host_master = jax.tree_util.tree_map(
-            lambda l: np.ascontiguousarray(np.asarray(jax.device_get(l),
-                                                      dtype=np.float32)),
+            lambda l: np.array(jax.device_get(l), dtype=np.float32,
+                               copy=True),
             params_f32)
         self._host_master_flat, self._host_treedef = \
             jax.tree_util.tree_flatten(host_master)
@@ -1823,11 +1827,13 @@ class DeepSpeedEngine:
             leaves = npz_dict_to_leaves(off)
             n = len(self._host_master_flat)
             assert len(leaves) == 3 * n
-            self._host_master_flat = [np.ascontiguousarray(l)
+            # np.array(copy=True): loaded npz views can be read-only and
+            # the host Adam updates these buffers in place
+            self._host_master_flat = [np.array(l, copy=True)
                                       for l in leaves[:n]]
-            self._host_opt["m"] = [np.ascontiguousarray(l)
+            self._host_opt["m"] = [np.array(l, copy=True)
                                    for l in leaves[n:2 * n]]
-            self._host_opt["v"] = [np.ascontiguousarray(l)
+            self._host_opt["v"] = [np.array(l, copy=True)
                                    for l in leaves[2 * n:]]
             self._host_opt["step"] = int(off["opt_step"])
             # host-side skip counter: meta holds device + host total; the

@@ -342,3 +342,30 @@ def test_moe_elastic_checkpoint_dp8_to_dp4(tmp_path):
         w.sharding.shard_shape(w.shape)
     resumed = float(jax.device_get(e4.train_batch(batch=batch)))
     np.testing.assert_allclose(resumed, cont, rtol=2e-4)
+
+
+def test_moe_with_zero_offload_trains(mesh8):
+    """ZeRO-Offload + expert-parallel MoE: host-resident optimizer over
+    'data'-sharded expert params."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    cfg = GPT2Config(vocab_size=128, n_positions=32, n_embd=32, n_layer=2,
+                     n_head=2, dtype=jnp.float32, loss_chunk_tokens=0,
+                     moe_num_experts=8, moe_top_k=2)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2Model(cfg), config_params={
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2, "cpu_offload": True},
+            "mesh": {"data": 8, "model": 1, "pipe": 1},
+            "steps_per_print": 10 ** 9,
+        })
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (1, 8, 32))
+    batch = {"input_ids": ids, "labels": ids.copy()}
+    losses = [float(jax.device_get(engine.train_batch(batch=batch)))
+              for _ in range(10)]
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses

@@ -369,3 +369,35 @@ def test_moe_with_zero_offload_trains(mesh8):
               for _ in range(10)]
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_moe_with_tensor_parallel_matches_dp_only():
+    """EP x TP: experts sharded over 'data', expert FFN hidden dim over
+    'model' — trajectory matches the dp-only run."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    def run(mesh_cfg):
+        cfg = GPT2Config(vocab_size=128, n_positions=32, n_embd=32,
+                         n_layer=2, n_head=2, dtype=jnp.float32,
+                         loss_chunk_tokens=0, moe_num_experts=4,
+                         moe_top_k=2)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2Model(cfg), config_params={
+                "train_batch_size": 4,
+                "train_micro_batch_size_per_gpu": 4 // mesh_cfg["data"],
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1},
+                "mesh": dict(mesh_cfg, allow_partial=True),
+                "steps_per_print": 10 ** 9,
+            })
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 128, (1, 4, 32))
+        batch = {"input_ids": ids, "labels": ids.copy()}
+        return [float(jax.device_get(engine.train_batch(batch=batch)))
+                for _ in range(5)]
+
+    base = run({"data": 4, "model": 1, "pipe": 1})
+    tp = run({"data": 4, "model": 2, "pipe": 1})
+    assert all(np.isfinite(base)) and base[-1] < base[0], base
+    np.testing.assert_allclose(base, tp, rtol=2e-4)

@@ -165,3 +165,34 @@ def test_bert_fused_layer_seq_axis_parity():
     sp = run({"data": 2, "seq": 4, "model": 1, "pipe": 1})
     assert all(np.isfinite(base)), base
     np.testing.assert_allclose(base, sp, rtol=2e-4)
+
+
+def test_pipeline_with_seq_axis_matches_pipe_only():
+    """PP x SP: 1F1B over stage submeshes that carry a nontrivial 'seq'
+    axis — trajectory matches the sp=1 pipeline run."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline_module
+
+    def run(mesh_cfg):
+        cfg = GPT2Config(vocab_size=128, n_positions=64, n_embd=32,
+                         n_layer=2, n_head=4, dtype=jnp.float32)
+        module = gpt2_pipeline_module(cfg, partition_method="uniform")
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=module, config_params={
+                "train_batch_size": 2 * mesh_cfg["data"] * 2,
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "mesh": dict(mesh_cfg, allow_partial=True),
+                "steps_per_print": 10 ** 9,
+            })
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 128, (2, 2 * mesh_cfg["data"], 64))
+        batch = {"input_ids": ids, "labels": ids.copy()}
+        return [float(engine.train_batch(batch=batch)) for _ in range(4)]
+
+    base = run({"pipe": 2, "data": 2, "model": 1})
+    sp = run({"pipe": 2, "data": 2, "seq": 2, "model": 1})
+    assert all(np.isfinite(base)) and base[-1] < base[0], base
+    np.testing.assert_allclose(base, sp, rtol=2e-4)

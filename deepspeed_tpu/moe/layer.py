@@ -10,9 +10,9 @@ from deepspeed_tpu.moe.sharded_moe import MoE as _MoE
 
 
 def MoE(hidden_size: int, num_experts: int = 1, k: int = 1,
-        capacity_factor: float = 1.0, min_capacity: int = 4,
-        expert_intermediate_size: int = 0, aux_loss_coef: float = 0.01,
-        noisy_gate_policy: str = None, **kw):
+        capacity_factor: float = 1.0, eval_capacity_factor: float = 0.0,
+        min_capacity: int = 4, expert_intermediate_size: int = 0,
+        aux_loss_coef: float = 0.01, noisy_gate_policy: str = None, **kw):
     """Build the flax MoE layer with DeepSpeed-MoE argument names.
 
     noisy_gate_policy: None or 'Jitter' (maps to router_jitter=0.01;
@@ -22,5 +22,6 @@ def MoE(hidden_size: int, num_experts: int = 1, k: int = 1,
     return _MoE(num_experts=num_experts,
                 d_ff=expert_intermediate_size or 4 * hidden_size,
                 k=k, capacity_factor=capacity_factor,
+                eval_capacity_factor=eval_capacity_factor,
                 min_capacity=min_capacity, aux_loss_coef=aux_loss_coef,
                 router_jitter=jitter, **kw)

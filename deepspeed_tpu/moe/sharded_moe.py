@@ -155,6 +155,9 @@ class MoE(nn.Module):
     d_ff: int
     k: int = 2
     capacity_factor: float = 1.25
+    eval_capacity_factor: float = 0.0   # 0 = same as capacity_factor;
+                                        # eval typically uses a larger
+                                        # factor so fewer tokens drop
     min_capacity: int = 4
     aux_loss_coef: float = 0.01
     router_jitter: float = 0.0
@@ -173,8 +176,10 @@ class MoE(nn.Module):
                 1.0 - self.router_jitter, 1.0 + self.router_jitter)
         logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
                           param_dtype=jnp.float32, name="router")(xr)
+        cf = self.capacity_factor if train or not self.eval_capacity_factor \
+            else self.eval_capacity_factor
         combine, dispatch, aux, _ = top_k_gating(
-            logits, k=self.k, capacity_factor=self.capacity_factor,
+            logits, k=self.k, capacity_factor=cf,
             min_capacity=self.min_capacity)
         self.sow("losses", "moe_aux_loss",
                  jnp.float32(self.aux_loss_coef) * aux,

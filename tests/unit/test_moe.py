@@ -401,3 +401,24 @@ def test_moe_with_tensor_parallel_matches_dp_only():
     tp = run({"data": 4, "model": 2, "pipe": 1})
     assert all(np.isfinite(base)) and base[-1] < base[0], base
     np.testing.assert_allclose(base, tp, rtol=2e-4)
+
+
+def test_eval_capacity_factor():
+    """Eval capacity: with a tiny train factor tokens drop, while a large
+    eval_capacity_factor keeps them all at eval time."""
+    rng = jax.random.PRNGKey(5)
+    x = jax.random.normal(rng, (1, 32, 16), jnp.float32)
+    moe = MoE(num_experts=2, d_ff=16, k=1, capacity_factor=0.25,
+              eval_capacity_factor=4.0, min_capacity=1, dtype=jnp.float32)
+    params = moe.init({"params": rng}, x, train=False)["params"]
+    y_train, _ = moe.apply({"params": params}, x, train=True,
+                           mutable=["losses"],
+                           rngs={"dropout": jax.random.PRNGKey(0)})
+    y_eval, _ = moe.apply({"params": params}, x, train=False,
+                          mutable=["losses"])
+    # dropped tokens output exactly zero; train (capacity 4/expert over 32
+    # tokens) must drop some, eval (ample) must not
+    train_zero = int(jnp.sum(jnp.all(y_train == 0, axis=-1)))
+    eval_zero = int(jnp.sum(jnp.all(y_eval == 0, axis=-1)))
+    assert train_zero > 0, "tiny train capacity dropped nothing"
+    assert eval_zero == 0, f"eval capacity dropped {eval_zero} tokens"

@@ -43,6 +43,11 @@ class GPT2Config:
                                    # keeps peak memory O(chunk*V) not O(B*S*V).
                                    # 8192 on v5e: scan overhead amortized to
                                    # parity with the dense head (round-4 sweep)
+    # attention under a nontrivial 'seq' mesh axis: 'ulysses' = all_to_all
+    # head/seq reshard around a full-sequence kernel (parallel/ulysses.py);
+    # 'ring' = K/V rotation with O(S/N) attention memory
+    # (parallel/ring_attention.py; no dropout path)
+    attention_sp_mode: str = "ulysses"
     # Mixture-of-Experts (expert parallelism over the 'data' mesh axis;
     # moe/sharded_moe.py). 0 experts = dense model. Every moe_layer_freq-th
     # block (the odd ones, GShard-style alternation) swaps its MLP for MoE.
@@ -91,21 +96,41 @@ class CausalSelfAttention(nn.Module):
 
         q, k, v = heads(q), heads(k), heads(v)
         drop_rng = self.make_rng("dropout") if (train and cfg.dropout > 0) else None
-        # Ulysses sequence parallelism (parallel/ulysses.py): with a
-        # nontrivial 'seq' axis these constraints flip the sequence dim to
-        # full and shard heads over ('model','seq') instead (GSPMD
-        # all_to_all) so the attention kernel sees the whole sequence.
-        # Every dim names its axes — a partial spec would pin the batch's
-        # 'data' and the heads' 'model' sharding to replicated.
-        head_sp = P("data", ("model", "seq"), None, None)
-        q = mesh_lib.constrain(q, head_sp)
-        k = mesh_lib.constrain(k, head_sp)
-        v = mesh_lib.constrain(v, head_sp)
-        y = scaled_dot_product_attention(
-            q, k, v, causal=True, dropout_rng=drop_rng,
-            dropout_rate=cfg.dropout if train else 0.0,
-            use_pallas=cfg.use_pallas_attention)
-        y = mesh_lib.constrain(y, P("data", "model", "seq", None))
+        amesh = jax.sharding.get_abstract_mesh()
+        ring = (cfg.attention_sp_mode == "ring" and amesh is not None
+                and not amesh.empty and amesh.shape.get("seq", 1) > 1)
+        if ring:
+            # ring sequence parallelism: K/V shards rotate over the 'seq'
+            # axis, attention memory stays O(S/N) per device
+            # (parallel/ring_attention.py)
+            assert drop_rng is None, \
+                "attention_sp_mode='ring' has no dropout path"
+            from deepspeed_tpu.parallel.ring_attention import (
+                _ring_attention_local)
+
+            spec = P("data", "model", "seq", None)
+            y = jax.shard_map(
+                lambda qq, kk, vv: _ring_attention_local(
+                    qq, kk, vv, axis_name="seq", causal=True, scale=None,
+                    vary_axes=("data", "model")),
+                in_specs=(spec, spec, spec), out_specs=spec,
+                axis_names={"data", "model", "seq"})(q, k, v)
+        else:
+            # Ulysses sequence parallelism (parallel/ulysses.py): with a
+            # nontrivial 'seq' axis these constraints flip the sequence dim
+            # to full and shard heads over ('model','seq') instead (GSPMD
+            # all_to_all) so the attention kernel sees the whole sequence.
+            # Every dim names its axes — a partial spec would pin the
+            # batch's 'data' and the heads' 'model' sharding to replicated.
+            head_sp = P("data", ("model", "seq"), None, None)
+            q = mesh_lib.constrain(q, head_sp)
+            k = mesh_lib.constrain(k, head_sp)
+            v = mesh_lib.constrain(v, head_sp)
+            y = scaled_dot_product_attention(
+                q, k, v, causal=True, dropout_rng=drop_rng,
+                dropout_rate=cfg.dropout if train else 0.0,
+                use_pallas=cfg.use_pallas_attention)
+            y = mesh_lib.constrain(y, P("data", "model", "seq", None))
         y = y.transpose(0, 2, 1, 3).reshape(B, S, E)
         y = nn.Dense(E, dtype=cfg.dtype, name="c_proj")(y)
         if train and cfg.dropout > 0:

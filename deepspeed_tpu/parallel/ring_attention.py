@@ -24,9 +24,15 @@ from jax import lax
 NEG_INF = -1e30
 
 
-def _ring_attention_local(q, k, v, axis_name, causal, scale):
+def _ring_attention_local(q, k, v, axis_name, causal, scale,
+                          vary_axes=()):
     """Per-device body: q,k,v are (B, H, S_local, D) shards, sequence
     sharded over `axis_name`. Must run inside shard_map with the axis bound.
+
+    vary_axes: additional manual axes of the enclosing shard_map (e.g.
+    'data'/'model' when batch/heads are also mapped) — the loop-carry
+    accumulators must declare themselves device-varying over those axes
+    too, or the fori_loop carry types mismatch after the first round.
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -62,9 +68,10 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale):
 
     # pvary: the accumulators become device-varying over the ring axis after
     # the first round; the loop carry type must declare that up front
-    m0 = lax.pcast(jnp.full((B, H, S, 1), NEG_INF, jnp.float32), (axis_name,), to='varying')
-    l0 = lax.pcast(jnp.zeros((B, H, S, 1), jnp.float32), (axis_name,), to='varying')
-    acc0 = lax.pcast(jnp.zeros((B, H, S, D), jnp.float32), (axis_name,), to='varying')
+    axes = (axis_name,) + tuple(vary_axes)
+    m0 = lax.pcast(jnp.full((B, H, S, 1), NEG_INF, jnp.float32), axes, to='varying')
+    l0 = lax.pcast(jnp.zeros((B, H, S, 1), jnp.float32), axes, to='varying')
+    acc0 = lax.pcast(jnp.zeros((B, H, S, D), jnp.float32), axes, to='varying')
     m, l, acc, _, _ = lax.fori_loop(0, n, round_body, (m0, l0, acc0, k, v))
 
     l_safe = jnp.where(l == 0.0, 1.0, l)

@@ -196,3 +196,35 @@ def test_pipeline_with_seq_axis_matches_pipe_only():
     sp = run({"pipe": 2, "data": 2, "seq": 2, "model": 1})
     assert all(np.isfinite(base)) and base[-1] < base[0], base
     np.testing.assert_allclose(base, sp, rtol=2e-4)
+
+
+def test_engine_ring_mode_matches_dp_only():
+    """attention_sp_mode='ring' through the engine: K/V ring rotation over
+    the 'seq' axis reproduces the dp-only trajectory."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+    def run(mesh_cfg, mode):
+        cfg = GPT2Config(vocab_size=128, n_positions=64, n_embd=32,
+                         n_layer=2, n_head=4, dtype=jnp.float32,
+                         loss_chunk_tokens=0, attention_sp_mode=mode)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2Model(cfg), config_params={
+                "train_batch_size": 4,
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2},
+                "mesh": dict(mesh_cfg, allow_partial=True),
+                "steps_per_print": 10 ** 9,
+            })
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 128, (1, 4, 64))
+        batch = {"input_ids": ids, "labels": ids.copy()}
+        return [float(jax.device_get(engine.train_batch(batch=batch)))
+                for _ in range(5)]
+
+    base = run({"data": 2, "model": 1, "pipe": 1}, "ulysses")
+    ring = run({"data": 2, "seq": 4, "model": 1, "pipe": 1}, "ring")
+    assert all(np.isfinite(base)) and base[-1] < base[0], base
+    np.testing.assert_allclose(base, ring, rtol=2e-4)

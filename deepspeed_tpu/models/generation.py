@@ -1,0 +1,161 @@
+"""Autoregressive generation for GPT-2 with a KV cache.
+
+The reference snapshot has no generation utility (inference arrived in
+later DeepSpeed); this is a TPU-first extension: the whole decode loop is
+ONE `lax.scan` inside jit (static token count, no host round-trips), the
+KV cache is a preallocated (L, B, H, S_max, D) pair updated with
+`dynamic_update_slice`, and sampling is counter-based (one PRNG key per
+step, folded from a base key).
+
+The decode math consumes the SAME params pytree as GPT2LMHead — stacked
+(scan_layers=True) or per-layer — and a parity test pins it to the
+training forward (tests/unit/test_generation.py).
+"""
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _ln(x, p, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _dense(x, p):
+    return x @ p["kernel"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
+def _block_params(params, cfg):
+    """Yield per-layer param trees; handles scan-stacked layouts."""
+    if cfg.scan_layers:
+        stacked = params["h"]["block"]
+        return [jax.tree_util.tree_map(lambda l, i=i: l[i], stacked)
+                for i in range(cfg.n_layer)]
+    return [params[f"h_{i}"] for i in range(cfg.n_layer)]
+
+
+def _attn_decode(x, p, cache_k, cache_v, pos, cfg):
+    """One-token attention against the cache. x: (B, 1, E); cache_k/v:
+    (B, H, S_max, D); pos: scalar int32 current position."""
+    B = x.shape[0]
+    H, D = cfg.n_head, cfg.head_dim
+    qkv = _dense(x, p["c_attn"])                       # (B, 1, 3E)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(B, 1, H, D).transpose(0, 2, 1, 3)  # (B, H, 1, D)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, 0, pos, 0))
+    cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, pos, 0))
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, cache_k,
+                   preferred_element_type=jnp.float32) * (D ** -0.5)
+    # mask out the not-yet-written tail of the cache
+    valid = jnp.arange(cache_k.shape[2]) <= pos        # (S_max,)
+    s = jnp.where(valid[None, None, None, :], s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+    y = jnp.einsum("bhqk,bhkd->bhqd", probs, cache_v)  # (B, H, 1, D)
+    y = y.transpose(0, 2, 1, 3).reshape(B, 1, cfg.n_embd)
+    return _dense(y, p["c_proj"]), cache_k, cache_v
+
+
+def _block_decode(x, bp, ck, cv, pos, cfg):
+    a, ck, cv = _attn_decode(
+        _ln(x, bp["ln_1"], cfg.layer_norm_epsilon), bp["attn"], ck, cv,
+        pos, cfg)
+    x = x + a
+    h = _ln(x, bp["ln_2"], cfg.layer_norm_epsilon)
+    mp = bp["mlp"]
+    h = jax.nn.gelu(_dense(h, mp["c_fc"]), approximate=True)
+    x = x + _dense(h, mp["c_proj"])
+    return x, ck, cv
+
+
+def _forward_token(params, cfg, token, pos, caches_k, caches_v):
+    """Embed one token, run all blocks against the cache, return logits.
+    token: (B,) int32; caches: (L, B, H, S_max, D)."""
+    wte = params["wte"]
+    wpe = params["wpe"]
+    x = wte.astype(cfg.dtype)[token][:, None, :] \
+        + wpe.astype(cfg.dtype)[pos][None, None, :]    # (B, 1, E)
+    blocks = _block_params(params, cfg)
+    new_k, new_v = [], []
+    for i, bp in enumerate(blocks):
+        x, ck, cv = _block_decode(x, bp, caches_k[i], caches_v[i], pos, cfg)
+        new_k.append(ck)
+        new_v.append(cv)
+    x = _ln(x, params["ln_f"], cfg.layer_norm_epsilon)
+    logits = jnp.einsum("bse,ve->bsv", x, wte.astype(cfg.dtype))
+    return logits[:, 0].astype(jnp.float32), \
+        jnp.stack(new_k), jnp.stack(new_v)
+
+
+def _sample(logits, key, temperature, top_k):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k and top_k < logits.shape[-1]:
+        # top_k >= vocab filters nothing; clamping keeps the arg safe
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(model, params, input_ids, max_new_tokens: int,
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             rng=None):
+    """Generate `max_new_tokens` continuations. input_ids: (B, S0) int.
+    temperature 0 = greedy. Returns (B, S0 + max_new_tokens) int32.
+
+    Prefill runs positions one at a time through the same jitted scan as
+    decode (simple and cache-exact; for long prompts a batched prefill is
+    the obvious optimization).
+    """
+    cfg = model.config
+    assert not cfg.moe_num_experts, \
+        "generate() does not support MoE configs yet (dense blocks only)"
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    B, S0 = input_ids.shape
+    S_max = S0 + max_new_tokens
+    assert S_max <= cfg.n_positions, \
+        f"{S_max} exceeds n_positions={cfg.n_positions}"
+    L, H, D = cfg.n_layer, cfg.n_head, cfg.head_dim
+    caches_k = jnp.zeros((L, B, H, S_max, D), cfg.dtype)
+    caches_v = jnp.zeros((L, B, H, S_max, D), cfg.dtype)
+    key = rng if rng is not None else jax.random.PRNGKey(0)
+
+    # cfg is a frozen (hashable) dataclass, so the decode program caches
+    # per (config, shapes, sampling) — repeat generate() calls reuse the
+    # compiled scan instead of re-tracing a fresh closure
+    run = _decode_fn(cfg, S0, S_max, float(temperature), int(top_k or 0))
+    out = run(params, input_ids, caches_k, caches_v, key)
+    seq = jnp.concatenate([input_ids[:, :1], jnp.transpose(out)], axis=1)
+    return np.asarray(seq)
+
+
+@functools.lru_cache(maxsize=32)
+def _decode_fn(cfg, S0, S_max, temperature, top_k):
+    def run(params, tokens_in, caches_k, caches_v, key):
+        def step(carry, pos):
+            tok, ck, cv = carry
+            logits, ck, cv = _forward_token(params, cfg, tok, pos, ck, cv)
+            nxt = _sample(logits, jax.random.fold_in(key, pos),
+                          temperature, top_k)
+            # while still inside the prompt, emit the prompt token
+            in_prompt = pos + 1 < S0
+            nxt = jnp.where(in_prompt,
+                            tokens_in[:, jnp.minimum(pos + 1, S0 - 1)], nxt)
+            return (nxt, ck, cv), nxt
+
+        (_, _, _), out = jax.lax.scan(
+            step, (tokens_in[:, 0], caches_k, caches_v),
+            jnp.arange(S_max - 1))
+        return out  # (S_max-1, B)
+
+    return jax.jit(run)

@@ -1,0 +1,94 @@
+"""KV-cache generation: decode math must match the training forward."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.models.generation import generate
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+
+def _model(scan_layers):
+    cfg = GPT2Config(vocab_size=97, n_positions=32, n_embd=32, n_layer=3,
+                     n_head=4, dtype=jnp.float32, scan_layers=scan_layers,
+                     loss_chunk_tokens=0)
+    model = GPT2Model(cfg)
+    ids = np.random.default_rng(0).integers(0, 97, (2, 8))
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": ids, "labels": ids})
+    return model, params
+
+
+@pytest.mark.parametrize("scan_layers", [False, True])
+def test_greedy_matches_full_forward(scan_layers):
+    """Greedy decode with the KV cache must equal greedy decode by
+    re-running the full training forward each step."""
+    model, params = _model(scan_layers)
+    prompt = np.random.default_rng(1).integers(0, 97, (2, 4))
+    out = generate(model, params, prompt, max_new_tokens=6)
+
+    seq = prompt.copy()
+    for _ in range(6):
+        logits = model.module.apply({"params": params},
+                                    jnp.asarray(seq, jnp.int32),
+                                    train=False)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], -1))[:, None]
+        seq = np.concatenate([seq, nxt], axis=1)
+    np.testing.assert_array_equal(out, seq)
+
+
+def test_prompt_is_preserved():
+    model, params = _model(False)
+    prompt = np.random.default_rng(2).integers(0, 97, (3, 5))
+    out = generate(model, params, prompt, max_new_tokens=3)
+    np.testing.assert_array_equal(out[:, :5], prompt)
+    assert out.shape == (3, 8)
+
+
+def test_sampling_deterministic_per_key_and_in_vocab():
+    model, params = _model(False)
+    prompt = np.random.default_rng(3).integers(0, 97, (2, 3))
+    a = generate(model, params, prompt, max_new_tokens=5, temperature=0.8,
+                 top_k=10, rng=jax.random.PRNGKey(7))
+    b = generate(model, params, prompt, max_new_tokens=5, temperature=0.8,
+                 top_k=10, rng=jax.random.PRNGKey(7))
+    c = generate(model, params, prompt, max_new_tokens=5, temperature=0.8,
+                 top_k=10, rng=jax.random.PRNGKey(8))
+    np.testing.assert_array_equal(a, b)
+    assert (a >= 0).all() and (a < 97).all()
+    assert not np.array_equal(a, c), "different keys produced same sample"
+
+
+def test_context_limit_asserted():
+    model, params = _model(False)
+    with pytest.raises(AssertionError, match="n_positions"):
+        generate(model, params, np.zeros((1, 30), np.int32),
+                 max_new_tokens=10)
+
+
+def test_moe_config_rejected():
+    cfg = GPT2Config(vocab_size=64, n_embd=16, n_layer=2, n_head=2,
+                     moe_num_experts=4)
+    model = GPT2Model(cfg)
+    with pytest.raises(AssertionError, match="MoE"):
+        generate(model, {}, np.zeros((1, 4), np.int32), max_new_tokens=2)
+
+
+def test_huge_top_k_is_safe():
+    model, params = _model(False)
+    prompt = np.random.default_rng(4).integers(0, 97, (1, 3))
+    out = generate(model, params, prompt, max_new_tokens=3,
+                   temperature=1.0, top_k=500)
+    assert out.shape == (1, 6)
+
+
+def test_decode_program_is_cached():
+    from deepspeed_tpu.models.generation import _decode_fn
+
+    model, params = _model(False)
+    prompt = np.random.default_rng(5).integers(0, 97, (2, 4))
+    _decode_fn.cache_clear()
+    generate(model, params, prompt, max_new_tokens=3)
+    generate(model, params, prompt, max_new_tokens=3)
+    info = _decode_fn.cache_info()
+    assert info.hits >= 1, info

@@ -1,10 +1,10 @@
 """Capture a jax.profiler trace of engine.train_batch on the real chip.
 
 Usage:  python tools/profile_step.py [model] [batch] [seq] [steps]
-Writes a TensorBoard-loadable trace under ./profile_out/ and prints the
-top-level step timing. The trace shows per-op device time (MXU vs VPU vs
-HBM stalls) — the ground truth for the bench tuning loop (VERDICT round-3
-item 1: profile before tuning).
+Writes a TensorBoard-loadable trace under <repo>/profile_out/ and prints
+the top-level step timing. The trace shows per-op device time (MXU vs VPU
+vs HBM stalls) — the ground truth for the bench tuning loop (VERDICT
+round-3 item 1: profile before tuning).
 """
 import os
 import sys
@@ -23,26 +23,28 @@ MODEL = sys.argv[1] if len(sys.argv) > 1 else "gpt2-350m"
 BS = int(sys.argv[2]) if len(sys.argv) > 2 else 48
 SEQ = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
 STEPS = int(sys.argv[4]) if len(sys.argv) > 4 else 5
-OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                   "profile_out")
+OUT = os.path.normpath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                 "profile_out"))
 
 
 def main():
     cfg = gpt2_config(MODEL, n_positions=SEQ, dtype=jnp.bfloat16,
                       remat=True, scan_layers=True)
     model = GPT2Model(cfg)
+    n_dev = len(jax.devices())
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config_params={
-        "train_batch_size": BS,
+        "train_batch_size": BS * n_dev,
         "train_micro_batch_size_per_gpu": BS,
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 2},
-        "mesh": {"data": 1, "model": 1, "pipe": 1},
+        "mesh": {"data": n_dev, "model": 1, "pipe": 1},
         "steps_per_print": 10 ** 9,
     })
     rng = np.random.default_rng(0)
-    ids = rng.integers(0, cfg.vocab_size, (1, BS, SEQ))
+    ids = rng.integers(0, cfg.vocab_size, (1, BS * n_dev, SEQ))
     batch = {"input_ids": ids, "labels": ids.copy()}
 
     # compile + warm

@@ -77,6 +77,50 @@ def _block_decode(x, bp, ck, cv, pos, cfg):
     return x, ck, cv
 
 
+def _attn_prefill(x, p, cfg):
+    """Causal attention over the whole prompt; returns (out, k, v) with
+    k/v shaped (B, H, S0, D) for cache seeding."""
+    B, S, E = x.shape
+    H, D = cfg.n_head, cfg.head_dim
+    qkv = _dense(x, p["c_attn"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * (D ** -0.5)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    s = jnp.where(mask[None, None], s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+    y = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    y = y.transpose(0, 2, 1, 3).reshape(B, S, E)
+    return _dense(y, p["c_proj"]), k, v
+
+
+def _prefill(params, cfg, tokens):
+    """One batched forward over the (B, S0) prompt: returns the logits at
+    the last prompt position and per-layer K/V for cache seeding."""
+    S0 = tokens.shape[1]
+    x = params["wte"].astype(cfg.dtype)[tokens] \
+        + params["wpe"].astype(cfg.dtype)[None, :S0]
+    ks, vs = [], []
+    for bp in _block_params(params, cfg):
+        a, k, v = _attn_prefill(
+            _ln(x, bp["ln_1"], cfg.layer_norm_epsilon), bp["attn"], cfg)
+        x = x + a
+        h = _ln(x, bp["ln_2"], cfg.layer_norm_epsilon)
+        h = jax.nn.gelu(_dense(h, bp["mlp"]["c_fc"]), approximate=True)
+        x = x + _dense(h, bp["mlp"]["c_proj"])
+        ks.append(k)
+        vs.append(v)
+    x = _ln(x, params["ln_f"], cfg.layer_norm_epsilon)
+    logits = jnp.einsum("be,ve->bv", x[:, -1],
+                        params["wte"].astype(cfg.dtype))
+    return logits.astype(jnp.float32), jnp.stack(ks), jnp.stack(vs)
+
+
 def _forward_token(params, cfg, token, pos, caches_k, caches_v):
     """Embed one token, run all blocks against the cache, return logits.
     token: (B,) int32; caches: (L, B, H, S_max, D)."""
@@ -113,9 +157,8 @@ def generate(model, params, input_ids, max_new_tokens: int,
     """Generate `max_new_tokens` continuations. input_ids: (B, S0) int.
     temperature 0 = greedy. Returns (B, S0 + max_new_tokens) int32.
 
-    Prefill runs positions one at a time through the same jitted scan as
-    decode (simple and cache-exact; for long prompts a batched prefill is
-    the obvious optimization).
+    The prompt is consumed by ONE batched causal forward (prefill) that
+    seeds the KV cache; decode then scans one token at a time.
     """
     cfg = model.config
     assert not cfg.moe_num_experts, \
@@ -135,27 +178,33 @@ def generate(model, params, input_ids, max_new_tokens: int,
     # compiled scan instead of re-tracing a fresh closure
     run = _decode_fn(cfg, S0, S_max, float(temperature), int(top_k or 0))
     out = run(params, input_ids, caches_k, caches_v, key)
-    seq = jnp.concatenate([input_ids[:, :1], jnp.transpose(out)], axis=1)
+    seq = jnp.concatenate([input_ids, jnp.transpose(out)], axis=1)
     return np.asarray(seq)
 
 
 @functools.lru_cache(maxsize=32)
 def _decode_fn(cfg, S0, S_max, temperature, top_k):
     def run(params, tokens_in, caches_k, caches_v, key):
+        # batched prefill over the prompt seeds positions [0, S0)
+        logits0, pk, pv = _prefill(params, cfg, tokens_in)
+        caches_k = jax.lax.dynamic_update_slice(
+            caches_k, pk, (0, 0, 0, 0, 0))
+        caches_v = jax.lax.dynamic_update_slice(
+            caches_v, pv, (0, 0, 0, 0, 0))
+        first = _sample(logits0, jax.random.fold_in(key, S0 - 1),
+                        temperature, top_k)
+
         def step(carry, pos):
             tok, ck, cv = carry
             logits, ck, cv = _forward_token(params, cfg, tok, pos, ck, cv)
             nxt = _sample(logits, jax.random.fold_in(key, pos),
                           temperature, top_k)
-            # while still inside the prompt, emit the prompt token
-            in_prompt = pos + 1 < S0
-            nxt = jnp.where(in_prompt,
-                            tokens_in[:, jnp.minimum(pos + 1, S0 - 1)], nxt)
             return (nxt, ck, cv), nxt
 
-        (_, _, _), out = jax.lax.scan(
-            step, (tokens_in[:, 0], caches_k, caches_v),
-            jnp.arange(S_max - 1))
-        return out  # (S_max-1, B)
+        # decode steps consume tokens at positions S0 .. S_max-2
+        (_, _, _), rest = jax.lax.scan(
+            step, (first, caches_k, caches_v),
+            jnp.arange(S0, S_max - 1))
+        return jnp.concatenate([first[None], rest], axis=0)  # (new, B)
 
     return jax.jit(run)

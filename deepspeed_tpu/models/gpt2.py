@@ -338,3 +338,9 @@ class GPT2Model:
 
     def num_params(self, params):
         return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+    def generate(self, params, input_ids, max_new_tokens, **kw):
+        """KV-cache autoregressive decoding (models/generation.py)."""
+        from deepspeed_tpu.models.generation import generate
+
+        return generate(self, params, input_ids, max_new_tokens, **kw)

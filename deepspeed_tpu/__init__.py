@@ -91,4 +91,7 @@ def __getattr__(name):
     if name == "checkpointing":
         from deepspeed_tpu.runtime import activation_checkpointing
         return activation_checkpointing
+    if name == "moe":
+        from deepspeed_tpu import moe
+        return moe
     raise AttributeError(f"module 'deepspeed_tpu' has no attribute {name!r}")

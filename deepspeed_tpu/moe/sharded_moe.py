@@ -160,6 +160,9 @@ class MoE(nn.Module):
                                         # factor so fewer tokens drop
     min_capacity: int = 4
     aux_loss_coef: float = 0.01
+    router_z_loss_coef: float = 0.0   # ST-MoE router z-loss: penalizes
+                                      # large router logits, stabilizing
+                                      # bf16 gating at scale
     router_jitter: float = 0.0
     dtype: Any = jnp.bfloat16
 
@@ -181,8 +184,12 @@ class MoE(nn.Module):
         combine, dispatch, aux, _ = top_k_gating(
             logits, k=self.k, capacity_factor=cf,
             min_capacity=self.min_capacity)
-        self.sow("losses", "moe_aux_loss",
-                 jnp.float32(self.aux_loss_coef) * aux,
+        total_aux = jnp.float32(self.aux_loss_coef) * aux
+        if self.router_z_loss_coef:
+            z = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+            total_aux = total_aux \
+                + jnp.float32(self.router_z_loss_coef) * jnp.mean(z * z)
+        self.sow("losses", "moe_aux_loss", total_aux,
                  init_fn=lambda: jnp.float32(0.0),
                  reduce_fn=lambda a, b: a + b)
 

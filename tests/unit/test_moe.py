@@ -422,3 +422,35 @@ def test_eval_capacity_factor():
     eval_zero = int(jnp.sum(jnp.all(y_eval == 0, axis=-1)))
     assert train_zero > 0, "tiny train capacity dropped nothing"
     assert eval_zero == 0, f"eval capacity dropped {eval_zero} tokens"
+
+
+def test_router_z_loss():
+    """z-loss adds coef * mean(logsumexp(logits)^2) to the sown aux and
+    pushes router logits toward zero through its gradient."""
+    rng = jax.random.PRNGKey(9)
+    x = jax.random.normal(rng, (2, 16, 8), jnp.float32)
+
+    def sown_aux(z_coef, p=None):
+        moe = MoE(num_experts=4, d_ff=16, k=1, aux_loss_coef=0.0,
+                  router_z_loss_coef=z_coef, dtype=jnp.float32)
+        params = p if p is not None else \
+            moe.init({"params": rng}, x, train=False)["params"]
+        _, col = moe.apply({"params": params}, x, train=False,
+                           mutable=["losses"])
+        return moe, params, sum_moe_losses(col["losses"])
+
+    _, params, aux0 = sown_aux(0.0)
+    moe_z, _, auxz = sown_aux(0.01, params)
+    assert float(aux0) == 0.0
+    logits = x.reshape(-1, 8) @ params["router"]["kernel"]
+    z = jax.nn.logsumexp(logits, -1)
+    np.testing.assert_allclose(float(auxz), 0.01 * float(jnp.mean(z * z)),
+                               rtol=1e-5)
+
+    def loss(p):
+        _, col = moe_z.apply({"params": p}, x, train=False,
+                             mutable=["losses"])
+        return sum_moe_losses(col["losses"])
+
+    g = jax.grad(loss)(params)
+    assert float(jnp.abs(g["router"]["kernel"]).sum()) > 0

@@ -60,8 +60,17 @@ def run_worker(args) -> int:
 
     import numpy as np
 
+    if args.allow_cpu:
+        # debug mode: force the CPU backend BEFORE touching jax — with the
+        # axon tunnel down, letting the TPU plugin init would hang the
+        # worker (the env var alone is not enough; the plugin prepends
+        # itself to jax_platforms, same workaround as tests/conftest.py)
+        os.environ["JAX_PLATFORMS"] = "cpu"
     phase("importing_jax")
     import jax
+
+    if args.allow_cpu:
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     import deepspeed_tpu
@@ -79,6 +88,11 @@ def run_worker(args) -> int:
               f"publish a bogus perf number", file=sys.stderr, flush=True)
         return 3
 
+    if args.model == "bert-sparse":
+        return run_sparse_worker(args, jax, jnp, np, device_kind, platform)
+    if args.onebit:
+        return run_onebit_worker(args, jax, jnp, np, device_kind, platform,
+                                 n_dev)
     if args.model.startswith("bert"):
         # BERT-large seq128 is the reference's 64-TFLOPS/V100 headline
         # (docs/_posts/2020-05-28-fastest-bert-training.md:15-40); dropout 0
@@ -94,6 +108,7 @@ def run_worker(args) -> int:
     else:
         cfg = gpt2_config(args.model, n_positions=args.seq,
                           dtype=jnp.bfloat16, remat=bool(args.remat),
+                          remat_policy=args.remat_policy,
                           scan_layers=bool(args.scan_layers),
                           loss_chunk_tokens=args.loss_chunk)
         model = GPT2Model(cfg)
@@ -148,6 +163,14 @@ def run_worker(args) -> int:
     phase(f"steps_done:{elapsed:.2f}")
 
     n_params = model.num_params(engine.state.params)
+    # MXU-alignment vocab pad rows are inert (logits sliced/masked); don't
+    # let them inflate the 6ND model-flops claim
+    pad_rows = cfg.padded_vocab_size - cfg.vocab_size
+    if pad_rows:
+        if args.model.startswith("bert"):
+            n_params -= pad_rows * (cfg.hidden_size + 1)  # word emb + mlm_bias
+        else:
+            n_params -= pad_rows * cfg.n_embd             # tied wte
     steps_per_sec = args.steps / elapsed
     samples_per_sec = steps_per_sec * global_bs
     tokens_per_sec = samples_per_sec * args.seq
@@ -181,6 +204,131 @@ def run_worker(args) -> int:
     return 0
 
 
+def run_sparse_worker(args, jax, jnp, np, device_kind, platform):
+    """BASELINE config 4 (sparse attention, reference README.md:17 'up to
+    6x faster execution, 10x longer sequences'): block-sparse Pallas kernel
+    vs dense flash attention, fwd+bwd at long sequence. The win must come
+    from O(active blocks) compute, measured on-chip."""
+    import time as _t
+
+    from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+        block_sparse_attention)
+    from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+        FixedSparsityConfig)
+    from deepspeed_tpu.ops.transformer.functional import (
+        scaled_dot_product_attention)
+
+    B, H, S, D = args.batch, 16, args.seq, 64
+    block = 64
+    cfg = FixedSparsityConfig(num_heads=H, block=block,
+                              num_local_blocks=4, num_global_blocks=1)
+    layout = np.asarray(cfg.make_layout(S))
+    active = float(layout.sum()) / float(layout.size)
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
+    layout_j = jnp.asarray(layout)
+
+    def sparse_loss(q, k, v):
+        o = block_sparse_attention(q, k, v, layout_j, block)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def dense_loss(q, k, v):
+        o = scaled_dot_product_attention(q, k, v, causal=False)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def timed(fn):
+        g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
+        r = g(q, k, v)        # compile
+        jax.device_get(jax.tree_util.tree_leaves(r)[0])
+        t0 = _t.time()
+        for _ in range(args.steps):
+            r = g(q, k, v)
+        jax.device_get(jax.tree_util.tree_leaves(r)[0])
+        return (_t.time() - t0) / args.steps * 1000.0
+
+    sparse_ms = timed(sparse_loss)
+    dense_ms = timed(dense_loss)
+    speedup = dense_ms / sparse_ms
+    print(json.dumps({
+        "metric": f"block-sparse attention seq{S} fwd+bwd speedup vs dense "
+                  f"(Pallas LUT kernel, {active:.3f} active blocks)",
+        "value": round(speedup, 2),
+        "unit": "x",
+        # reference headline: 'up to 6x faster execution' (README.md:17)
+        "vs_baseline": round(speedup / 6.0, 3),
+        "sparse_ms": round(sparse_ms, 2), "dense_ms": round(dense_ms, 2),
+        "active_block_fraction": round(active, 4),
+        "tokens_per_sec_sparse": round(B * S / (sparse_ms / 1000.0), 1),
+        "device_kind": device_kind, "platform": platform,
+        "batch": B, "heads": H, "seq": S, "head_dim": D, "block": block,
+    }), flush=True)
+    return 0
+
+
+def run_onebit_worker(args, jax, jnp, np, device_kind, platform, n_dev):
+    """BASELINE config 5 (1-bit Adam, reference onebit-adam-blog-post.md:
+    85-135): warmup (dense Adam) vs post-freeze (compressed momentum) step
+    time through the full engine wire path. On one chip the collective is
+    local, so the honest single-chip signal is: compression adds no step
+    overhead (the comm win is proved separately by the HLO byte test,
+    tests/unit/test_onebit.py)."""
+    import time as _t
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Model, gpt2_config
+
+    freeze = 4
+    model_name = args.model if args.model.startswith("gpt2") else "gpt2-125m"
+    cfg = gpt2_config(model_name,
+                      n_positions=args.seq, dtype=jnp.bfloat16,
+                      remat=bool(args.remat), scan_layers=True,
+                      loss_chunk_tokens=args.loss_chunk)
+    model = GPT2Model(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config_params={
+        "train_batch_size": args.batch * n_dev,
+        "train_micro_batch_size_per_gpu": args.batch,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "OneBitAdam",
+                      "params": {"lr": 1e-4, "freeze_step": freeze}},
+        "bf16": {"enabled": True},
+        "mesh": {"data": n_dev, "model": 1, "pipe": 1},
+        "steps_per_print": 10 ** 9})
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (1, args.batch * n_dev, args.seq))
+    batch = {"input_ids": ids, "labels": ids.copy()}
+
+    def steps(n):
+        t0 = _t.time()
+        for _ in range(n):
+            loss = engine.train_batch(batch=batch)
+        float(jax.device_get(loss))
+        return (_t.time() - t0) / n * 1000.0
+
+    steps(1)                       # compile warmup program
+    warm_ms = steps(max(1, freeze - 2))   # stay inside warmup phase
+    while engine.global_steps <= freeze:  # cross the freeze boundary
+        engine.train_batch(batch=batch)
+    steps(1)                       # compile frozen program
+    frozen_ms = steps(args.steps)
+    print(json.dumps({
+        "metric": f"1-bit Adam post-freeze step time ({model_name} "
+                  f"seq{args.seq}, "
+                  f"{'wire path' if n_dev > 1 else 'single chip'}, "
+                  f"{n_dev} chip)",
+        "value": round(frozen_ms, 1),
+        "unit": "ms/step",
+        # single-chip target: compressed stage at least as fast as warmup
+        # (the 6.6x comm-stage headline needs a multi-node wire)
+        "vs_baseline": round(warm_ms / frozen_ms, 3),
+        "warmup_ms": round(warm_ms, 1), "frozen_ms": round(frozen_ms, 1),
+        "device_kind": device_kind, "platform": platform,
+        "n_devices": n_dev, "batch_per_chip": args.batch,
+    }), flush=True)
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # parent driver: attempt ladder + retries + structured failure
 # ---------------------------------------------------------------------------
@@ -188,7 +336,8 @@ def run_worker(args) -> int:
 def _attempt_cmd(base, spec):
     cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
     for k in ("model", "batch", "seq", "steps", "warmup", "scan_layers",
-              "remat", "allow_cpu", "loss_chunk", "offload"):
+              "remat", "remat_policy", "allow_cpu", "loss_chunk", "offload",
+              "onebit"):
         cmd += [f"--{k}", str(spec.get(k, getattr(base, k)))]
     return cmd
 
@@ -304,6 +453,8 @@ def main():
     p.add_argument("--model", default="gpt2-350m")
     p.add_argument("--scan_layers", type=int, default=1)
     p.add_argument("--remat", type=int, default=1)
+    p.add_argument("--remat_policy", default="nothing",
+                   help="what per-block remat saves: nothing|attn_out|dots")
     p.add_argument("--batch", type=int, default=48)
     p.add_argument("--loss_chunk", type=int, default=8192,
                    help="chunked LM-head xent tokens (0 = dense logits)")
@@ -321,6 +472,9 @@ def main():
                    help="debug only: let the worker publish a CPU number")
     p.add_argument("--offload", type=int, default=0,
                    help="ZeRO-Offload: host fp32 master + C++ AVX Adam")
+    p.add_argument("--onebit", type=int, default=0,
+                   help="BASELINE config 5: OneBitAdam wire path, warmup vs "
+                        "post-freeze step time")
     args = p.parse_args()
     if args.worker:
         return run_worker(args)

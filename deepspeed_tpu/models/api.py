@@ -15,6 +15,13 @@ The TPU engine is functional: a model is anything exposing
 from typing import Any, Callable, Optional
 
 
+def pad_to_multiple(n: int, multiple: int) -> int:
+    """Ceil `n` to a multiple (MXU lane alignment for vocab dims); 0/None
+    multiple returns n unchanged. Single source of truth for GPT2Config,
+    BertConfig and the HF weight loader."""
+    return -(-n // multiple) * multiple if multiple else n
+
+
 class FlaxModel:
     """Adapter: flax linen module -> engine model contract.
 
@@ -77,7 +84,8 @@ def cross_entropy_loss(logits, labels, ignore_index: Optional[int] = None):
 
 
 def chunked_lm_cross_entropy(hidden, wte, labels, chunk_tokens: int = 2048,
-                             ignore_index: Optional[int] = -100):
+                             ignore_index: Optional[int] = -100,
+                             valid_vocab: Optional[int] = None):
     """Memory-efficient LM head + softmax cross entropy.
 
     Computes mean(-log softmax(hidden @ wte.T)[labels]) WITHOUT materializing
@@ -90,7 +98,10 @@ def chunked_lm_cross_entropy(hidden, wte, labels, chunk_tokens: int = 2048,
     for the same reason, csrc/transformer/softmax_kernels.cu).
 
     hidden: (..., E) activations entering the LM head (already shifted);
-    wte: (V, E) tied embedding; labels: (...) int targets aligned to hidden.
+    wte: (V, E) tied embedding; labels: (...) int targets aligned to hidden;
+    valid_vocab: when wte carries MXU-alignment pad rows (V > true vocab),
+    columns >= valid_vocab are masked out of the softmax so padding stays an
+    invisible layout detail.
     """
     import jax
     import jax.numpy as jnp
@@ -122,6 +133,9 @@ def chunked_lm_cross_entropy(hidden, wte, labels, chunk_tokens: int = 2048,
         logits = jax.lax.dot_general(
             xc, wte.astype(xc.dtype), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)          # (chunk, V) f32
+        if valid_vocab is not None and valid_vocab < wte.shape[0]:
+            cols = jax.lax.iota(jnp.int32, wte.shape[0])
+            logits = jnp.where(cols[None, :] < valid_vocab, logits, -1e9)
         m = jnp.max(logits, axis=-1)
         logz = jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1)) + m
         safe = jnp.where(mc > 0, yc, 0)

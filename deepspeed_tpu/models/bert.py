@@ -20,6 +20,9 @@ from deepspeed_tpu.ops.transformer.transformer import (
 @dataclasses.dataclass(frozen=True)
 class BertConfig:
     vocab_size: int = 30522
+    # MXU lane alignment for the embedding + tied MLM-head matmuls
+    # (30522 -> 30592); logits are sliced back, ids stay < vocab_size
+    pad_vocab_multiple: int = 128
     hidden_size: int = 768
     num_hidden_layers: int = 12
     num_attention_heads: int = 12
@@ -33,6 +36,12 @@ class BertConfig:
     pre_layer_norm: bool = True
     dtype: Any = jnp.bfloat16
     remat: bool = False
+
+    @property
+    def padded_vocab_size(self):
+        from deepspeed_tpu.models.api import pad_to_multiple
+
+        return pad_to_multiple(self.vocab_size, self.pad_vocab_multiple)
 
 
 BERT_SIZES = {
@@ -73,7 +82,7 @@ class BertEmbeddings(nn.Module):
         cfg = self.config
         S = input_ids.shape[1]
         word = self.param("word_embeddings", nn.initializers.normal(
-            cfg.initializer_range), (cfg.vocab_size, cfg.hidden_size),
+            cfg.initializer_range), (cfg.padded_vocab_size, cfg.hidden_size),
             jnp.float32)
         pos = self.param("position_embeddings", nn.initializers.normal(
             cfg.initializer_range),
@@ -135,9 +144,11 @@ class BertForPreTrainingModule(nn.Module):
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="mlm_ln")(h)
         mlm_bias = self.param("mlm_bias", nn.initializers.zeros,
-                              (cfg.vocab_size,), jnp.float32)
+                              (cfg.padded_vocab_size,), jnp.float32)
         logits = jnp.einsum("bse,ve->bsv", h, word.astype(cfg.dtype)) \
             + mlm_bias.astype(cfg.dtype)
+        # drop MXU-alignment pad columns before the loss/softmax
+        logits = logits[..., :cfg.vocab_size]
 
         # NSP over the pooled [CLS]
         pooled = nn.tanh(nn.Dense(cfg.hidden_size, dtype=cfg.dtype,

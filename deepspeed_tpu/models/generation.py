@@ -118,7 +118,9 @@ def _prefill(params, cfg, tokens):
     x = _ln(x, params["ln_f"], cfg.layer_norm_epsilon)
     logits = jnp.einsum("be,ve->bv", x[:, -1],
                         params["wte"].astype(cfg.dtype))
-    return logits.astype(jnp.float32), jnp.stack(ks), jnp.stack(vs)
+    # drop MXU-alignment pad columns so sampling never picks a pad id
+    return logits[:, :cfg.vocab_size].astype(jnp.float32), \
+        jnp.stack(ks), jnp.stack(vs)
 
 
 def _forward_token(params, cfg, token, pos, caches_k, caches_v):
@@ -136,7 +138,7 @@ def _forward_token(params, cfg, token, pos, caches_k, caches_v):
         new_v.append(cv)
     x = _ln(x, params["ln_f"], cfg.layer_norm_epsilon)
     logits = jnp.einsum("bse,ve->bsv", x, wte.astype(cfg.dtype))
-    return logits[:, 0].astype(jnp.float32), \
+    return logits[:, 0, :cfg.vocab_size].astype(jnp.float32), \
         jnp.stack(new_k), jnp.stack(new_v)
 
 
@@ -164,6 +166,8 @@ def generate(model, params, input_ids, max_new_tokens: int,
     assert not cfg.moe_num_experts, \
         "generate() does not support MoE configs yet (dense blocks only)"
     input_ids = jnp.asarray(input_ids, jnp.int32)
+    if max_new_tokens <= 0:
+        return np.asarray(input_ids)
     B, S0 = input_ids.shape
     S_max = S0 + max_new_tokens
     assert S_max <= cfg.n_positions, \

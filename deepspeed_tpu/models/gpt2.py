@@ -17,6 +17,7 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.models.api import (chunked_lm_cross_entropy,
@@ -28,6 +29,11 @@ from deepspeed_tpu.parallel import mesh as mesh_lib
 @dataclasses.dataclass(frozen=True)
 class GPT2Config:
     vocab_size: int = 50257
+    # Pad the embedding/LM-head vocab dim to a multiple of this so the two
+    # biggest matmuls in the model tile cleanly onto the MXU's 128 lanes
+    # (50257 -> 50304). Purely an internal layout: ids stay < vocab_size,
+    # logits are sliced/masked back to vocab_size everywhere. 0 disables.
+    pad_vocab_multiple: int = 128
     n_positions: int = 1024
     n_embd: int = 768
     n_layer: int = 12
@@ -36,6 +42,13 @@ class GPT2Config:
     layer_norm_epsilon: float = 1e-5
     dtype: Any = jnp.bfloat16      # compute dtype
     remat: bool = False            # activation checkpointing per block
+    # remat policy: what the per-block checkpoint SAVES (everything else is
+    # recomputed in the backward). 'nothing' = full remat (max memory
+    # saving, max recompute); 'attn_out' = save the flash-attention outputs
+    # (skips recomputing the attention kernel — the most expensive fwd op —
+    # while still freeing the big QK/PV intermediates); 'dots' = save every
+    # matmul output (least recompute, most memory)
+    remat_policy: str = "nothing"
     scan_layers: bool = False      # lax.scan over blocks: compile time O(1)
                                    # in depth, params stacked (L, ...)
     use_pallas_attention: Optional[bool] = None  # None = auto
@@ -61,6 +74,12 @@ class GPT2Config:
     def head_dim(self):
         return self.n_embd // self.n_head
 
+    @property
+    def padded_vocab_size(self):
+        from deepspeed_tpu.models.api import pad_to_multiple
+
+        return pad_to_multiple(self.vocab_size, self.pad_vocab_multiple)
+
 
 # named configs; 1.5B mirrors the reference's 48L/1600h perf config
 GPT2_SIZES = {
@@ -78,6 +97,19 @@ def gpt2_config(name: str, **overrides) -> GPT2Config:
     base = dict(GPT2_SIZES[name])
     base.update(overrides)
     return GPT2Config(**base)
+
+
+def remat_policy(name: str):
+    """Map a GPT2Config.remat_policy name to a jax.checkpoint policy
+    (None = save nothing, i.e. classic full remat)."""
+    if name in ("nothing", "", None):
+        return None
+    if name == "attn_out":
+        return jax.checkpoint_policies.save_only_these_names("attn_out")
+    if name == "dots":
+        return jax.checkpoint_policies.dots_saveable
+    raise ValueError(f"unknown remat_policy {name!r} "
+                     "(expected nothing|attn_out|dots)")
 
 
 class CausalSelfAttention(nn.Module):
@@ -132,6 +164,9 @@ class CausalSelfAttention(nn.Module):
                 use_pallas=cfg.use_pallas_attention)
             y = mesh_lib.constrain(y, P("data", "model", "seq", None))
         y = y.transpose(0, 2, 1, 3).reshape(B, S, E)
+        # marker for remat_policy='attn_out': saving here means the backward
+        # re-runs only the (cheap) projections/LN/GeLU, not the attention
+        y = checkpoint_name(y, "attn_out")
         y = nn.Dense(E, dtype=cfg.dtype, name="c_proj")(y)
         if train and cfg.dropout > 0:
             y = nn.Dropout(cfg.dropout)(y, deterministic=False)
@@ -191,7 +226,7 @@ class GPT2LMHead(nn.Module):
         cfg = self.config
         B, S = input_ids.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
-                         (cfg.vocab_size, cfg.n_embd), jnp.float32)
+                         (cfg.padded_vocab_size, cfg.n_embd), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.n_positions, cfg.n_embd), jnp.float32)
         x = wte.astype(cfg.dtype)[input_ids] + wpe.astype(cfg.dtype)[None, :S]
@@ -199,7 +234,8 @@ class GPT2LMHead(nn.Module):
             x = nn.Dropout(cfg.dropout)(x, deterministic=False)
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, static_argnums=(2,))
+            block = nn.remat(Block, static_argnums=(2,),
+                             policy=remat_policy(cfg.remat_policy))
         if cfg.moe_num_experts:
             # heterogeneous layers (dense/MoE alternation) can't share one
             # scanned body; unrolled loop only
@@ -233,9 +269,10 @@ class GPT2LMHead(nn.Module):
             # training loss path: the chunked xent applies the tied head
             # itself without materializing full logits
             return x, wte
-        # tied LM head: logits against the embedding matrix
+        # tied LM head: logits against the embedding matrix; the matmul runs
+        # at the padded (MXU-aligned) width, then the pad columns drop out
         logits = jnp.einsum("bse,ve->bsv", x, wte.astype(cfg.dtype))
-        return logits
+        return logits[..., :cfg.vocab_size]
 
 
 def gpt2_tp_leaf_spec(joined: str, leaf, stacked: bool = False):
@@ -305,7 +342,8 @@ class GPT2Model:
             # next-token LM loss, chunked head (no full-logits residual)
             loss, metrics = chunked_lm_cross_entropy(
                 hidden[:, :-1], wte, batch["labels"][:, 1:],
-                chunk_tokens=chunk, ignore_index=-100)
+                chunk_tokens=chunk, ignore_index=-100,
+                valid_vocab=cfg.vocab_size)
         else:
             logits, aux = apply()
             # next-token LM loss

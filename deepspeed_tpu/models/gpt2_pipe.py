@@ -25,7 +25,7 @@ class GPT2Embed(nn.Module):
         cfg = self.config
         S = input_ids.shape[1]
         wte = self.param("wte", nn.initializers.normal(0.02),
-                         (cfg.vocab_size, cfg.n_embd), jnp.float32)
+                         (cfg.padded_vocab_size, cfg.n_embd), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (cfg.n_positions, cfg.n_embd), jnp.float32)
         x = wte.astype(cfg.dtype)[input_ids] + wpe.astype(cfg.dtype)[None, :S]
@@ -53,9 +53,11 @@ class GPT2FinalNorm(nn.Module):
 
 
 def _tied_lm_head(module, params, x):
-    """forward_fn for the tied head: logits against the shared wte."""
+    """forward_fn for the tied head: logits against the shared wte (run at
+    the MXU-padded width, pad columns sliced off)."""
     wte = params["wte"]
-    return jnp.einsum("bse,ve->bsv", x, wte.astype(x.dtype))
+    logits = jnp.einsum("bse,ve->bsv", x, wte.astype(x.dtype))
+    return logits[..., :module.config.vocab_size]
 
 
 def _tp_spec(params):

@@ -101,14 +101,31 @@ def _t(x):
     return x.T if x.ndim == 2 else x
 
 
-def load_hf_gpt2_params(hf_params):
+def load_hf_gpt2_params(hf_params, config=None, pad_vocab_multiple=128):
     """transformers FlaxGPT2LMHeadModel params -> models/gpt2.GPT2LMHead
     params (non-scan layout): bring pretrained HF GPT-2 weights into this
     framework. Layer subtrees keep their structure (ln_1/attn/ln_2/mlp);
-    2D kernels transpose from HF's (out, in) Conv1D layout."""
+    2D kernels transpose from HF's (out, in) Conv1D layout; wte grows zero
+    pad rows up to GPT2Config.padded_vocab_size (MXU lane alignment — the
+    model slices/masks logits back, so the rows are inert).
+
+    Pass the target GPT2Config so the loader pads to EXACTLY the shape the
+    model will init (a config with pad_vocab_multiple=0 or a non-default
+    multiple must not meet a 128-padded table); pad_vocab_multiple is the
+    fallback when no config is given."""
+    from deepspeed_tpu.models.api import pad_to_multiple
+
+    if config is not None:
+        pad_vocab_multiple = config.pad_vocab_multiple
     t = hf_params.get("transformer", hf_params)
+    wte = np.asarray(t["wte"]["embedding"])
+    target = pad_to_multiple(wte.shape[0], pad_vocab_multiple)
+    if target > wte.shape[0]:
+        wte = np.concatenate(
+            [wte, np.zeros((target - wte.shape[0], wte.shape[1]),
+                           wte.dtype)])
     out = {
-        "wte": np.asarray(t["wte"]["embedding"]),
+        "wte": wte,
         "wpe": np.asarray(t["wpe"]["embedding"]),
         "ln_f": {k: np.asarray(v) for k, v in t["ln_f"].items()},
     }

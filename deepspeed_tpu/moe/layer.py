@@ -18,6 +18,13 @@ def MoE(hidden_size: int, num_experts: int = 1, k: int = 1,
     noisy_gate_policy: None or 'Jitter' (maps to router_jitter=0.01;
     DeepSpeed's 'RSample' has no equivalent here).
     """
+    if noisy_gate_policy not in (None, "Jitter"):
+        # a ported DeepSpeed config expecting RSample noise must not get
+        # silently-different gating
+        raise ValueError(
+            f"noisy_gate_policy={noisy_gate_policy!r} is not supported; "
+            "use None or 'Jitter' (DeepSpeed's 'RSample' has no equivalent "
+            "in this build)")
     jitter = 0.01 if noisy_gate_policy == "Jitter" else 0.0
     return _MoE(num_experts=num_experts,
                 d_ff=expert_intermediate_size or 4 * hidden_size,

@@ -31,6 +31,11 @@ from jax.experimental.pallas import tpu as pltpu
 # blocks are clamped to the sequence length at call time
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
+# optional overrides for the backward sweeps only (0 = inherit fwd blocks);
+# settable via env DSTPU_FLASH_BWD_BLOCK_Q/K for on-chip sweeps
+import os as _os
+_BWD_BLOCK_Q = int(_os.environ.get("DSTPU_FLASH_BWD_BLOCK_Q", "0"))
+_BWD_BLOCK_K = int(_os.environ.get("DSTPU_FLASH_BWD_BLOCK_K", "0"))
 NEG_INF = -1e30
 
 
@@ -378,8 +383,17 @@ def _flash_bwd(res, g, *, scale, causal, bias_kind, num_heads, dropout_rate,
     do = g
     bh, s_q, d = q.shape
     s_k = k.shape[1]
-    block_q = min(block_q, s_q)
-    block_k = min(block_k, s_k)
+    # the backward sweeps accumulate into (block, d) fp32 scratch and run a
+    # 5-matmul body — their best tile shape differs from the forward's;
+    # independent env knobs let tools/flash_tune2.py sweep them on-chip.
+    # A knob with no 128-aligned divisor fails as loudly as the forward
+    # does (flash_attention.py asserts in flash_attention()) — a partial
+    # Pallas block would silently corrupt the gradients.
+    block_q = _fit_block(min(_BWD_BLOCK_Q or block_q, s_q), s_q)
+    block_k = _fit_block(min(_BWD_BLOCK_K or block_k, s_k), s_k)
+    assert block_q is not None and block_k is not None, (
+        f"flash backward: DSTPU_FLASH_BWD_BLOCK_Q/K={_BWD_BLOCK_Q}/"
+        f"{_BWD_BLOCK_K} have no 128-aligned divisor of seq ({s_q}, {s_k})")
     nq = pl.cdiv(s_q, block_q)
     nk = pl.cdiv(s_k, block_k)
 
@@ -486,7 +500,10 @@ def _flash_3d_bwd(scale, causal, bias_kind, num_heads, dropout_rate, block_q,
     # bias is a constant additive mask (HF extended mask / key padding):
     # no gradient is produced for it (zeros keep the vjp total)
     dbias = None if res[3] is None else jnp.zeros_like(res[3])
-    dseed = None if res[4] is None else jnp.zeros_like(res[4])
+    # integer primals take float0 cotangents (JAX convention for the int32
+    # seed; a zeros_like int cotangent only works by accident)
+    dseed = None if res[4] is None else \
+        jnp.zeros(res[4].shape, dtype=jax.dtypes.float0)
     return dq, dk, dv, dbias, dseed
 
 

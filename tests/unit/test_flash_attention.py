@@ -247,3 +247,20 @@ def test_dropout_dispatch_from_functional():
                                        use_pallas=True)
     assert out.shape == q.shape
     assert float(jnp.max(jnp.abs(out - ref))) > 1e-4
+
+def test_dropout_gradients_multiblock():
+    """Same FD guard across a multi-block grid: the regenerated masks must
+    use the right (q_start, k_start) offsets in BOTH backward sweeps."""
+    from jax.test_util import check_grads
+
+    rng = np.random.default_rng(13)
+    q = jnp.asarray(rng.standard_normal((1, 1, 256, 64)) * 0.3, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 1, 256, 64)) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 1, 256, 64)) * 0.3, jnp.float32)
+
+    def f(q, k, v):
+        return _flash(q, k, v, dropout_rate=0.25, dropout_seed=7,
+                      causal=True, block_q=128, block_k=128)\
+            .astype(jnp.float32).sum()
+
+    check_grads(f, (q, k, v), order=1, modes=["rev"], rtol=2e-2, atol=2e-2)

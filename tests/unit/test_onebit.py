@@ -338,3 +338,36 @@ def test_onebit_wire_rejects_gradient_clipping(eight_devices):
         engine.train_batch(batch={
             "x": rng.standard_normal((1, 8, 16)).astype(np.float32),
             "y": rng.integers(0, 4, (1, 8)).astype(np.int32)})
+
+
+def test_onebit_disarmed_warns_loudly(eight_devices, caplog):
+    """OneBitAdam + ZeRO-2 silently falls back to dense gradient traffic —
+    the engine must say so at init instead of quietly no-oping the
+    compression the user asked for."""
+    import logging
+
+    import deepspeed_tpu
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+    from tests.unit.simple_model import SimpleModel
+
+    ds_logger.propagate = True  # the framework logger is propagate=False;
+    try:                        # caplog listens on the root logger
+        with caplog.at_level(logging.WARNING):
+            engine, _, _, _ = _init_disarmed(deepspeed_tpu, SimpleModel)
+    finally:
+        ds_logger.propagate = False
+    assert engine.optimizer.axis_name is None
+    msgs = [r.message for r in caplog.records
+            if "DISARMED" in r.message]
+    assert msgs and "zero_optimization.stage=2" in msgs[0]
+
+
+def _init_disarmed(deepspeed_tpu, SimpleModel):
+    return deepspeed_tpu.initialize(
+            model=SimpleModel(), config_params={
+                "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "OneBitAdam",
+                              "params": {"lr": 1e-3, "freeze_step": 2}},
+                "zero_optimization": {"stage": 2},
+                "mesh": {"data": 8}, "steps_per_print": 10 ** 9})

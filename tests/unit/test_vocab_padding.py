@@ -1,0 +1,88 @@
+"""MXU vocab padding must be an invisible layout detail.
+
+The embedding/LM-head matmuls run at padded_vocab_size (128-lane aligned,
+models/gpt2.py) but ids stay < vocab_size and logits are sliced/masked back
+— so a padded model and an unpadded model holding the same rows must agree
+on every user-visible number (loss, logits, samples)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+
+
+def _models(vocab=97, **kw):
+    """(padded model, unpadded model) sharing the live vocab rows."""
+    base = dict(vocab_size=vocab, n_positions=32, n_embd=32, n_layer=2,
+                n_head=2, dtype=jnp.float32, **kw)
+    padded = GPT2Model(GPT2Config(pad_vocab_multiple=128, **base))
+    plain = GPT2Model(GPT2Config(pad_vocab_multiple=0, **base))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, vocab, (2, 16)), jnp.int32)
+    batch = {"input_ids": ids, "labels": ids}
+    p_pad = padded.init(jax.random.PRNGKey(1), batch)
+    assert p_pad["wte"].shape[0] == 128
+    p_plain = jax.tree_util.tree_map(lambda x: x, p_pad)
+    p_plain["wte"] = p_pad["wte"][:vocab]
+    return padded, plain, p_pad, p_plain, batch
+
+
+def test_padded_vocab_size_values():
+    assert GPT2Config().padded_vocab_size == 50304
+    assert GPT2Config(pad_vocab_multiple=0).padded_vocab_size == 50257
+    assert GPT2Config(vocab_size=128).padded_vocab_size == 128
+
+
+def test_dense_logits_sliced_to_true_vocab():
+    padded, plain, p_pad, p_plain, batch = _models(loss_chunk_tokens=0)
+    logits = padded.module.apply({"params": p_pad}, batch["input_ids"])
+    assert logits.shape[-1] == 97
+    ref = plain.module.apply({"params": p_plain}, batch["input_ids"])
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_dense_loss_matches_unpadded():
+    padded, plain, p_pad, p_plain, batch = _models(loss_chunk_tokens=0)
+    key = jax.random.PRNGKey(0)
+    lp, _ = padded.loss(p_pad, batch, key, train=False)
+    lu, _ = plain.loss(p_plain, batch, key, train=False)
+    np.testing.assert_allclose(float(lp), float(lu), rtol=1e-6)
+
+
+def test_chunked_loss_masks_pad_columns():
+    """The chunked xent path sees the PADDED wte — random-init pad rows
+    must not leak into the softmax denominator."""
+    padded, plain, p_pad, p_plain, batch = _models(loss_chunk_tokens=8)
+    key = jax.random.PRNGKey(0)
+    lp, _ = padded.loss(p_pad, batch, key, train=False)
+    lu, _ = plain.loss(p_plain, batch, key, train=False)
+    np.testing.assert_allclose(float(lp), float(lu), rtol=1e-5)
+
+
+def test_pad_rows_get_no_gradient():
+    """Masked-out columns must produce zero gradient on the pad rows (an
+    optimizer would otherwise drift them for no reason)."""
+    padded, _, p_pad, _, batch = _models(loss_chunk_tokens=8)
+
+    g = jax.grad(lambda p: padded.loss(p, batch, jax.random.PRNGKey(0),
+                                       train=False)[0])(p_pad)
+    np.testing.assert_array_equal(np.asarray(g["wte"][97:]), 0.0)
+
+
+def test_generation_never_samples_pad_ids():
+    from deepspeed_tpu.models.generation import generate
+
+    padded, _, p_pad, _, batch = _models()
+    out = generate(padded, p_pad, batch["input_ids"][:, :8], 12,
+                   temperature=1.0, rng=jax.random.PRNGKey(3))
+    assert out.shape == (2, 20)
+    assert out.max() < 97
+
+
+def test_generate_zero_new_tokens_is_identity():
+    from deepspeed_tpu.models.generation import generate
+
+    padded, _, p_pad, _, batch = _models()
+    out = generate(padded, p_pad, batch["input_ids"][:, :8], 0)
+    np.testing.assert_array_equal(out, np.asarray(batch["input_ids"][:, :8]))

@@ -1173,10 +1173,23 @@ class DeepSpeedEngine:
                 and not self._offload)
 
     def _onebit_frozen(self) -> bool:
-        """Static freeze phase for program selection, keyed on engine steps
-        (includes scale-skipped steps; warmup is thousands of steps so the
-        off-by-a-few vs the reference's optimizer-step count is immaterial)."""
-        return (self.global_steps + 1) > self.optimizer.freeze_step
+        """Static freeze phase for program selection, keyed on OPTIMIZER
+        steps (engine steps minus scale-skipped steps — the reference's
+        count, onebit_adam.py freeze_step semantics). The skipped count is
+        a device scalar: it is read back once per train_batch during warmup
+        only, and the phase latches True so the post-freeze steady state
+        never syncs."""
+        if getattr(self, "_onebit_frozen_latch", False):
+            return True
+        skipped = 0
+        if self.state is not None and self.fp16_enabled():
+            import jax
+
+            skipped = int(jax.device_get(self.state.skipped_steps))
+        frozen = (self.global_steps - skipped + 1) > self.optimizer.freeze_step
+        if frozen:
+            self._onebit_frozen_latch = True
+        return frozen
 
     def _make_onebit_tail(self, frozen):
         """Shared optimizer tail for the wire path: overflow check ->
@@ -1971,6 +1984,10 @@ class DeepSpeedEngine:
 
         self.global_steps = meta["global_steps"]
         self.micro_steps = meta["micro_steps"]
+        # the 1-bit freeze phase latches on optimizer steps; a rollback to a
+        # pre-freeze tag must re-derive it from the restored counters, not
+        # keep serving the compressed program through what is warmup again
+        self._onebit_frozen_latch = False
         # skipped_steps restores with the device state (a TrainState leaf)
         if load_lr_scheduler_states and self.lr_scheduler is not None \
                 and meta.get("lr_scheduler") is not None:

@@ -3,13 +3,18 @@
 Reference behavior: deepspeed/runtime/pipe/schedule.py:6-482. The schedule is
 an algorithm spec, not an implementation detail: TrainSchedule emits the
 1F1B-interleaved stream (even/odd step -> micro-batch mapping, buffer count =
-min(stages - stage + 1, micro_batches)); the TPU engine consumes it two ways:
+min(stages - stage + 1, micro_batches)); the TPU engine executes it
+host-driven: each instruction is a jitted per-stage call, sends are
+device_put between adjacent stage submeshes (runtime/pipe/engine.py).
 
-- host-driven: execute each instruction as a jitted stage call + ppermute
-  (faithful, flexible);
-- fused: the whole stream is lowered into one jitted lax.scan over
-  "pipeline clock ticks" (runtime/pipe/engine.py) — the schedule still
-  defines WHAT happens at each tick.
+Why host-driven (and not one fused whole-schedule lax.scan): dispatch is
+asynchronous — the host enqueues every stage's program for a tick without
+waiting, so stage programs overlap on-device exactly as 1F1B intends, and
+the host cost is enqueue-only (measured by tools/pipe_bench.py; numbers in
+BENCH_NOTES.md). A single fused scan would need every stage's weights and
+buffers resident in ONE program over the whole mesh with uniform tick
+bodies, giving up heterogeneous stage partitions and per-stage remat
+choices; the measured enqueue overhead does not justify that trade.
 """
 
 

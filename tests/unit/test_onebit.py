@@ -371,3 +371,42 @@ def _init_disarmed(deepspeed_tpu, SimpleModel):
                               "params": {"lr": 1e-3, "freeze_step": 2}},
                 "zero_optimization": {"stage": 2},
                 "mesh": {"data": 8}, "steps_per_print": 10 ** 9})
+
+
+def test_onebit_freeze_counts_optimizer_steps_not_engine_steps(eight_devices):
+    """A scale-skipped step must not advance the freeze clock: freeze_step
+    counts OPTIMIZER steps (reference onebit_adam semantics), so an
+    overflow during fp16 warmup pushes the compressed phase out by one."""
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(), config_params={
+            "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "OneBitAdam",
+                          "params": {"lr": 1e-3, "freeze_step": 2}},
+            "fp16": {"enabled": True,
+                     "loss_scale": 0,
+                     "initial_scale_power": 4},
+            "mesh": {"data": 8}, "steps_per_print": 10 ** 9})
+    assert engine.optimizer.axis_name == "data"  # wire path armed
+    rng = np.random.default_rng(0)
+    good = {"x": rng.standard_normal((1, 8, 10)).astype(np.float32),
+            "y": rng.integers(0, 4, (1, 8)).astype(np.int32)}
+    # NaN activations -> NaN grads -> the scaler's overflow check trips
+    # (SimpleModel's tanh saturates, so big-but-finite inputs can't)
+    bad = {"x": np.full((1, 8, 10), np.nan, np.float32),
+           "y": good["y"].copy()}
+
+    engine.train_batch(batch=bad)    # overflow: skipped, no optimizer step
+    engine.train_batch(batch=good)   # optimizer step 1
+    skipped = int(jax.device_get(engine.state.skipped_steps))
+    assert skipped == 1, skipped
+    # engine steps = 2 > freeze_step, but optimizer steps = 1: NOT frozen
+    assert not engine._onebit_frozen()
+    engine.train_batch(batch=good)   # optimizer step 2
+    engine.train_batch(batch=good)   # optimizer step 3 -> crosses freeze
+    assert engine._onebit_frozen()
+    # latched: no further device sync needed
+    assert engine._onebit_frozen_latch

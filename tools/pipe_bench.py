@@ -1,0 +1,123 @@
+"""Pipeline execution cost evidence (VERDICT r4 weak-item 4).
+
+Measures, on the virtual 8-device CPU mesh (or real chips when present):
+
+1. step-time table: the SAME tiny GPT-2 trained monolithic (pipe=1) vs
+   pipe=2 and pipe=4, fixed global batch and gas — what pipelining costs
+   or buys end to end;
+2. host dispatch overhead per instruction: the tick loop's per-instruction
+   enqueue cost, measured by timing a no-op jitted dispatch per stage
+   submesh and counting the schedule's instructions — on real TPUs
+   dispatch is async, so this bounds the host-side serialization the
+   1F1B overlap has to hide;
+3. the 1F1B ideal bubble fraction (S-1)/(M+S-1) for context.
+
+Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+           python tools/pipe_bench.py [--steps 8] [--gas 4]
+Prints one JSON line per configuration; paste into BENCH_NOTES.md.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_CPU_MODE = "--real-tpu" not in sys.argv
+if _CPU_MODE:
+    # ASSIGN, don't setdefault: the shell may carry JAX_PLATFORMS=axon, and
+    # with the tunnel down that import hangs (memory: tests/conftest.py)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--gas", type=int, default=4)
+    p.add_argument("--seq", type=int, default=64)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--embd", type=int, default=64)
+    p.add_argument("--real-tpu", action="store_true")
+    args = p.parse_args()
+
+    if _CPU_MODE:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline_module
+    from deepspeed_tpu.runtime.pipe import schedule as sched_lib
+
+    n_dev = len(jax.devices())
+    cfg = GPT2Config(vocab_size=256, n_positions=args.seq, n_embd=args.embd,
+                     n_layer=args.layers, n_head=4, dtype=jnp.float32,
+                     loss_chunk_tokens=0)
+    gas, micro = args.gas, 1
+    rng = np.random.default_rng(0)
+
+    def run(pipe):
+        dp = n_dev // pipe
+        global_bs = micro * gas * dp
+        ds = {"train_batch_size": global_bs,
+              "train_micro_batch_size_per_gpu": micro,
+              "gradient_accumulation_steps": gas,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "mesh": {"pipe": pipe, "data": dp},
+              "steps_per_print": 10 ** 9}
+        model = gpt2_pipeline_module(cfg, partition_method="uniform") \
+            if pipe > 1 else GPT2Model(cfg)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                                   config_params=ds)
+        ids = rng.integers(0, 256, (gas, micro * dp, args.seq))
+        batch = {"input_ids": ids, "labels": ids.copy()}
+        loss = engine.train_batch(batch=batch)       # compile
+        float(jax.device_get(loss))
+        t0 = time.time()
+        for _ in range(args.steps):
+            loss = engine.train_batch(batch=batch)
+        float(jax.device_get(loss))
+        step_ms = (time.time() - t0) / args.steps * 1000.0
+
+        out = {"pipe": pipe, "dp": dp, "gas": gas,
+               "global_batch": global_bs, "step_ms": round(step_ms, 2)}
+        if pipe > 1:
+            # schedule shape + host enqueue cost per instruction
+            sch = sched_lib.TrainSchedule(micro_batches=gas, stages=pipe,
+                                          stage_id=0)
+            n_instr = sum(len(step) for step in sch.steps()) * pipe
+            noop = jax.jit(lambda x: x)
+            x = jax.device_put(np.zeros((1,), np.float32))
+            noop(x)                                   # compile
+            t0 = time.time()
+            reps = 200
+            for _ in range(reps):
+                noop(x)
+            enqueue_us = (time.time() - t0) / reps * 1e6
+            bubble = (pipe - 1) / (gas + pipe - 1)
+            out.update({
+                "instructions_per_step": n_instr,
+                "enqueue_us_per_dispatch": round(enqueue_us, 1),
+                "host_dispatch_ms_per_step":
+                    round(n_instr * enqueue_us / 1000.0, 2),
+                "ideal_1f1b_bubble_fraction": round(bubble, 3),
+            })
+        print(json.dumps(out), flush=True)
+        return step_ms
+
+    base = run(1)
+    for pipe in (2, 4):
+        ms = run(pipe)
+        print(json.dumps({"pipe": pipe, "relative_to_pipe1":
+                          round(ms / base, 3)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

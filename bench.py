@@ -90,6 +90,11 @@ def run_worker(args) -> int:
 
     if args.model == "bert-sparse":
         return run_sparse_worker(args, jax, jnp, np, device_kind, platform)
+    if args.sparse and not args.model.startswith("bert"):
+        print(f"FATAL: --sparse only applies to BERT models, got "
+              f"{args.model} — refusing to publish a mislabeled number",
+              file=sys.stderr, flush=True)
+        return 3
     if args.onebit:
         return run_onebit_worker(args, jax, jnp, np, device_kind, platform,
                                  n_dev)
@@ -100,10 +105,23 @@ def run_worker(args) -> int:
         # the Pallas flash kernel with the additive key-padding mask)
         from deepspeed_tpu.models.bert import BertForPreTraining, bert_config
 
+        sparsity = None
+        if args.sparse:
+            # BASELINE config 4 model-level: long-seq BERT through the
+            # block-sparse Pallas kernel (key padding rides the kernel as
+            # an in-kernel additive bias, so the mask stays in the batch)
+            from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+                FixedSparsityConfig)
+
+            heads = {"bert-base": 12, "bert-large": 16}[args.model]
+            sparsity = FixedSparsityConfig(num_heads=heads, block=64,
+                                           num_local_blocks=4,
+                                           num_global_blocks=1)
         cfg = bert_config(args.model, max_position_embeddings=args.seq,
                           dtype=jnp.bfloat16, remat=bool(args.remat),
                           hidden_dropout_prob=0.0,
-                          attention_probs_dropout_prob=0.0)
+                          attention_probs_dropout_prob=0.0,
+                          sparsity_config=sparsity)
         model = BertForPreTraining(cfg)
     else:
         cfg = gpt2_config(args.model, n_positions=args.seq,
@@ -135,6 +153,8 @@ def run_worker(args) -> int:
         # MLM: 15% of positions carry labels, rest are ignored (-100)
         labels = np.where(rng.random((1, global_bs, args.seq)) < 0.15,
                           ids, -100)
+        # the sparse path folds the key-padding mask into the Pallas kernel
+        # (block_sparse_kernel key_bias), so the mask stays in the batch
         batch = {"input_ids": ids,
                  "attention_mask": np.ones((1, global_bs, args.seq),
                                            np.int32),
@@ -182,7 +202,8 @@ def run_worker(args) -> int:
     vs_baseline = tflops_per_chip / REFERENCE_TFLOPS_PER_CHIP
 
     print(json.dumps({
-        "metric": f"{args.model} seq{args.seq} train TFLOPS/chip "
+        "metric": f"{args.model}{'-sparse' if args.sparse else ''} "
+                  f"seq{args.seq} train TFLOPS/chip "
                   f"(ZeRO-2{'+offload' if args.offload else ''} bf16, "
                   f"{n_dev} chip)",
         "value": round(tflops_per_chip, 2),
@@ -337,7 +358,7 @@ def _attempt_cmd(base, spec):
     cmd = [sys.executable, os.path.abspath(__file__), "--worker"]
     for k in ("model", "batch", "seq", "steps", "warmup", "scan_layers",
               "remat", "remat_policy", "allow_cpu", "loss_chunk", "offload",
-              "onebit"):
+              "onebit", "sparse"):
         cmd += [f"--{k}", str(spec.get(k, getattr(base, k)))]
     return cmd
 
@@ -475,6 +496,9 @@ def main():
     p.add_argument("--onebit", type=int, default=0,
                    help="BASELINE config 5: OneBitAdam wire path, warmup vs "
                         "post-freeze step time")
+    p.add_argument("--sparse", type=int, default=0,
+                   help="BERT models: block-sparse attention "
+                        "(FixedSparsityConfig local4+global1, block 64)")
     args = p.parse_args()
     if args.worker:
         return run_worker(args)

@@ -36,6 +36,10 @@ class BertConfig:
     pre_layer_norm: bool = True
     dtype: Any = jnp.bfloat16
     remat: bool = False
+    # ops/sparse_attention SparsityConfig: routes every encoder layer's
+    # attention through the block-sparse kernel (long-sequence BERT,
+    # reference README.md:17); params are identical to the dense model
+    sparsity_config: Any = None
 
     @property
     def padded_vocab_size(self):
@@ -71,7 +75,8 @@ def _layer_config(cfg: BertConfig) -> DeepSpeedTransformerConfig:
         bf16=cfg.dtype == jnp.bfloat16,
         fp16=cfg.dtype == jnp.float16,
         pre_layer_norm=cfg.pre_layer_norm,
-        normalize_invertible=cfg.remat)
+        normalize_invertible=cfg.remat,
+        sparsity_config=cfg.sparsity_config)
 
 
 class BertEmbeddings(nn.Module):

@@ -65,8 +65,14 @@ def build_luts(layout):
 # ---------------------------------------------------------------------------
 # forward: grid (bh, nq, max_nnz), k/v blocks indexed through the LUT
 # ---------------------------------------------------------------------------
-def _fwd_kernel(cols_ref, nnz_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, heads, max_nnz, nq):
+def _fwd_kernel(cols_ref, nnz_ref, *refs, scale, heads, max_nnz, nq,
+                has_bias):
+    if has_bias:
+        (q_ref, k_ref, v_ref, kb_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        kb_ref = None
     ai = pl.program_id(2)
 
     @pl.when(ai == 0)
@@ -87,6 +93,9 @@ def _fwd_kernel(cols_ref, nnz_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if kb_ref is not None:
+            # per-key additive bias (key padding): (1, block) row broadcast
+            s = s + kb_ref[...]
         m_prev = m_scr[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
@@ -110,7 +119,8 @@ def _fwd_kernel(cols_ref, nnz_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                                       lse_ref.shape[1:])
 
 
-def _sparse_fwd(q, k, v, cols, nnz, *, scale, block, heads, interpret):
+def _sparse_fwd(q, k, v, cols, nnz, *, scale, block, heads, interpret,
+                key_bias=None):
     bh, S, d = q.shape
     nq = S // block
     max_nnz = cols.shape[-1]
@@ -122,8 +132,17 @@ def _sparse_fwd(q, k, v, cols, nnz, *, scale, block, heads, interpret):
         kb = cols_ref[(h * nq + qi) * max_nnz + ai]
         return (b, kb, 0)
 
+    def kb_index(b, qi, ai, cols_ref, nnz_ref):
+        h = jax.lax.rem(b, heads)
+        kb = cols_ref[(h * nq + qi) * max_nnz + ai]
+        return (b // heads, kb)
+
+    bias_ops = [] if key_bias is None else [key_bias]
+    bias_specs = [] if key_bias is None else \
+        [pl.BlockSpec((1, block), kb_index)]
     kernel = functools.partial(_fwd_kernel, scale=scale, heads=heads,
-                               max_nnz=max_nnz, nq=nq)
+                               max_nnz=max_nnz, nq=nq,
+                               has_bias=key_bias is not None)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(bh, nq, max_nnz),
@@ -132,7 +151,7 @@ def _sparse_fwd(q, k, v, cols, nnz, *, scale, block, heads, interpret):
                          lambda b, qi, ai, cols_ref, nnz_ref: (b, qi, 0)),
             pl.BlockSpec((1, block, d), kv_index),
             pl.BlockSpec((1, block, d), kv_index),
-        ],
+        ] + bias_specs,
         out_specs=[
             pl.BlockSpec((1, block, d),
                          lambda b, qi, ai, cols_ref, nnz_ref: (b, qi, 0)),
@@ -150,15 +169,22 @@ def _sparse_fwd(q, k, v, cols, nnz, *, scale, block, heads, interpret):
         out_shape=[jax.ShapeDtypeStruct((bh, S, d), q.dtype),
                    jax.ShapeDtypeStruct((bh, S, 128), jnp.float32)],
         interpret=interpret,
-    )(cols_flat, nnz_flat, q, k, v)
+    )(cols_flat, nnz_flat, q, k, v, *bias_ops)
     return out, lse[:, :, 0]
 
 
 # ---------------------------------------------------------------------------
 # backward: dq walks the forward LUT; dk/dv walk the transpose LUT
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(cols_ref, nnz_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                   delta_ref, dq_ref, dq_scr, *, scale, heads, max_nnz, nq):
+def _bwd_dq_kernel(cols_ref, nnz_ref, *refs, scale, heads, max_nnz, nq,
+                   has_bias):
+    if has_bias:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_scr) = refs
+        kb_ref = None
     ai = pl.program_id(2)
 
     @pl.when(ai == 0)
@@ -180,6 +206,8 @@ def _bwd_dq_kernel(cols_ref, nnz_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         delta = delta_ref[0][:, 0:1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if kb_ref is not None:
+            s = s + kb_ref[...]
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -193,9 +221,15 @@ def _bwd_dq_kernel(cols_ref, nnz_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkdv_kernel(rows_ref, nnzt_ref, q_ref, k_ref, v_ref, do_ref,
-                     lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-                     *, scale, heads, max_nnz_t, nk):
+def _bwd_dkdv_kernel(rows_ref, nnzt_ref, *refs, scale, heads, max_nnz_t, nk,
+                     has_bias):
+    if has_bias:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kb_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        kb_ref = None
     ai = pl.program_id(2)
 
     @pl.when(ai == 0)
@@ -218,6 +252,10 @@ def _bwd_dkdv_kernel(rows_ref, nnzt_ref, q_ref, k_ref, v_ref, do_ref,
         delta = delta_ref[0][:, 0:1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if kb_ref is not None:
+            # this kernel's s is (q_rows, k_rows) with k fixed to block ki:
+            # the bias row for block ki broadcasts over q rows
+            s = s + kb_ref[...]
         p = jnp.exp(s - lse)
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -236,7 +274,7 @@ def _bwd_dkdv_kernel(rows_ref, nnzt_ref, q_ref, k_ref, v_ref, do_ref,
 
 
 def _sparse_bwd(res, do, *, scale, block, heads, interpret):
-    q, k, v, out, lse, cols, nnz, rows_t, nnz_t = res
+    q, k, v, key_bias, out, lse, cols, nnz, rows_t, nnz_t = res
     bh, S, d = q.shape
     nq = S // block
     max_nnz = cols.shape[-1]
@@ -259,9 +297,17 @@ def _sparse_bwd(res, do, *, scale, block, heads, interpret):
         h = jax.lax.rem(b, heads)
         return (b, cols_ref[(h * nq + qi) * max_nnz + ai], 0)
 
+    def kb_from_cols(b, qi, ai, cols_ref, nnz_ref):
+        h = jax.lax.rem(b, heads)
+        return (b // heads, cols_ref[(h * nq + qi) * max_nnz + ai])
+
+    bias_ops = [] if key_bias is None else [key_bias]
+    dq_bias_specs = [] if key_bias is None else \
+        [pl.BlockSpec((1, block), kb_from_cols)]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, heads=heads,
-                          max_nnz=max_nnz, nq=nq),
+                          max_nnz=max_nnz, nq=nq,
+                          has_bias=key_bias is not None),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(bh, nq, max_nnz),
@@ -272,13 +318,13 @@ def _sparse_bwd(res, do, *, scale, block, heads, interpret):
                 pl.BlockSpec((1, block, d), q_row),
                 pl.BlockSpec((1, block, 128), q_row),
                 pl.BlockSpec((1, block, 128), q_row),
-            ],
+            ] + dq_bias_specs,
             out_specs=pl.BlockSpec((1, block, d), q_row),
             scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((bh, S, d), q.dtype),
         interpret=interpret,
-    )(cols_flat, nnz_flat, q, k, v, do, lse_w, delta_w)
+    )(cols_flat, nnz_flat, q, k, v, do, lse_w, delta_w, *bias_ops)
 
     # ---- dk/dv: transpose LUT ------------------------------------------
     def q_from_rows(b, ki, ai, rows_ref, nnzt_ref):
@@ -288,9 +334,12 @@ def _sparse_bwd(res, do, *, scale, block, heads, interpret):
     def k_row(b, ki, ai, *refs):
         return (b, ki, 0)
 
+    dkdv_bias_specs = [] if key_bias is None else \
+        [pl.BlockSpec((1, block), lambda b, ki, ai, *r: (b // heads, ki))]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, heads=heads,
-                          max_nnz_t=max_nnz_t, nk=nq),
+                          max_nnz_t=max_nnz_t, nk=nq,
+                          has_bias=key_bias is not None),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(bh, nq, max_nnz_t),
@@ -301,7 +350,7 @@ def _sparse_bwd(res, do, *, scale, block, heads, interpret):
                 pl.BlockSpec((1, block, d), q_from_rows),
                 pl.BlockSpec((1, block, 128), q_from_rows),
                 pl.BlockSpec((1, block, 128), q_from_rows),
-            ],
+            ] + dkdv_bias_specs,
             out_specs=[pl.BlockSpec((1, block, d), k_row),
                        pl.BlockSpec((1, block, d), k_row)],
             scratch_shapes=[pltpu.VMEM((block, d), jnp.float32),
@@ -310,35 +359,40 @@ def _sparse_bwd(res, do, *, scale, block, heads, interpret):
         out_shape=[jax.ShapeDtypeStruct((bh, S, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, S, d), v.dtype)],
         interpret=interpret,
-    )(rows_flat, nnzt_flat, q, k, v, do, lse_w, delta_w)
+    )(rows_flat, nnzt_flat, q, k, v, do, lse_w, delta_w, *bias_ops)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
 # public entry: differentiable block-sparse attention over a layout
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _sparse_attention_core(q3, k3, v3, luts, scale, heads, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _sparse_attention_core(q3, k3, v3, key_bias, luts, scale, heads,
+                           interpret):
     out, _ = _sparse_fwd(q3, k3, v3, luts[0], luts[1], scale=scale,
                          block=q3.shape[1] // luts[1].shape[1], heads=heads,
-                         interpret=interpret)
+                         interpret=interpret, key_bias=key_bias)
     return out
 
 
-def _core_fwd(q3, k3, v3, luts, scale, heads, interpret):
+def _core_fwd(q3, k3, v3, key_bias, luts, scale, heads, interpret):
     block = q3.shape[1] // luts[1].shape[1]
     out, lse = _sparse_fwd(q3, k3, v3, luts[0], luts[1], scale=scale,
-                           block=block, heads=heads, interpret=interpret)
-    return out, (q3, k3, v3, out, lse)
+                           block=block, heads=heads, interpret=interpret,
+                           key_bias=key_bias)
+    return out, (q3, k3, v3, key_bias, out, lse)
 
 
 def _core_bwd(luts, scale, heads, interpret, res, do):
-    q3, k3, v3, out, lse = res
+    q3, k3, v3, key_bias, out, lse = res
     block = q3.shape[1] // luts[1].shape[1]
-    full_res = (q3, k3, v3, out, lse, luts[0], luts[1], luts[2], luts[3])
+    full_res = (q3, k3, v3, key_bias, out, lse,
+                luts[0], luts[1], luts[2], luts[3])
     dq, dk, dv = _sparse_bwd(full_res, do, scale=scale, block=block,
                              heads=heads, interpret=interpret)
-    return dq, dk, dv
+    # key padding is a constant mask, no gradient (flash kernel convention)
+    dkb = None if key_bias is None else jnp.zeros_like(key_bias)
+    return dq, dk, dv, dkb
 
 
 _sparse_attention_core.defvjp(_core_fwd, _core_bwd)
@@ -346,9 +400,15 @@ _sparse_attention_core.defvjp(_core_fwd, _core_bwd)
 
 def pallas_block_sparse_attention(q, k, v, layout, block: int,
                                   scale: Optional[float] = None,
+                                  key_bias=None,
                                   interpret: Optional[bool] = None):
     """(B, H, S, D) block-sparse attention over a (H, S/block, S/block)
-    layout via the LUT-driven Pallas kernels. Differentiable."""
+    layout via the LUT-driven Pallas kernels. Differentiable in q/k/v.
+
+    key_bias: optional (B, S) ADDITIVE per-key bias (key-padding mask,
+    -inf/-1e30 for padded keys) applied inside the kernel — long-sequence
+    BERT keeps its padding mask without falling back to the O(S^2) path.
+    Treated as constant (no gradient)."""
     if interpret is None:
         interpret = _interpret_default()
     B, H, S, D = q.shape
@@ -360,8 +420,11 @@ def pallas_block_sparse_attention(q, k, v, layout, block: int,
     q3 = q.reshape(B * H, S, D)
     k3 = k.reshape(B * H, S, D)
     v3 = v.reshape(B * H, S, D)
-    out = _sparse_attention_core(q3, k3, v3, _HashableLuts(luts), scale, H,
-                                 interpret)
+    if key_bias is not None:
+        assert key_bias.shape == (B, S), key_bias.shape
+        key_bias = jnp.asarray(key_bias, jnp.float32)
+    out = _sparse_attention_core(q3, k3, v3, key_bias, _HashableLuts(luts),
+                                 scale, H, interpret)
     return out.reshape(B, H, S, D)
 
 

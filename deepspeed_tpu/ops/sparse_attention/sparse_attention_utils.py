@@ -2,9 +2,13 @@
 
 Reference behavior: deepspeed/ops/sparse_attention/sparse_attention_utils.py:
 13-225 (pad/unpad sequences to a block multiple, extend position
-embeddings). The HF-model surgery part of the reference
-(replace_self_attention_layer_with_sparse_self_attention_layer) lives with
-module_inject in this build.
+embeddings, and swap a model's self-attention for the sparse kernel).
+
+The reference's swap mutates torch modules in place (:85-150). Models here
+are (config -> module, params) pairs where the sparse and dense attention
+share identical parameters (same QKV/out projections — only the attention
+pattern differs), so the swap is functional: a new config carrying the
+SparsityConfig plus untouched (or position-extended) params.
 """
 from typing import Optional
 
@@ -25,6 +29,70 @@ class SparseAttentionUtils:
             f"max_position {max_position} must exceed current {P}"
         reps = -(-max_position // P)
         return jnp.tile(pos_embedding, (reps, 1))[:max_position]
+
+    @staticmethod
+    def update_tokenizer_model_max_length(tokenizer, max_position: int):
+        """Reference :68-83 — point the tokenizer at the extended length."""
+        tokenizer.model_max_length = max_position
+        if hasattr(tokenizer, "init_kwargs"):
+            tokenizer.init_kwargs["model_max_length"] = max_position
+        return tokenizer
+
+    @staticmethod
+    def replace_model_self_attention_with_sparse_self_attention(
+            model, params, max_position: int, sparsity_config=None):
+        """Functional analog of reference :85-121: return (new_model,
+        new_params) where every encoder layer attends through the
+        block-sparse kernel and position embeddings cover max_position.
+
+        model: models/bert.BertForPreTraining (the fused-layer BERT this
+        build ships); params: its param tree. Attention projections are
+        reused verbatim — only the position table changes shape.
+        """
+        import dataclasses
+
+        from deepspeed_tpu.models.bert import BertForPreTraining
+        from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+            FixedSparsityConfig)
+
+        if not isinstance(model, BertForPreTraining):
+            raise TypeError(
+                "replace_model_self_attention_with_sparse_self_attention "
+                f"supports models/bert.BertForPreTraining, got {type(model)}"
+                " — build other families with sparsity_config directly")
+        if sparsity_config is None:
+            sparsity_config = FixedSparsityConfig(
+                num_heads=model.config.num_attention_heads)
+        cfg = dataclasses.replace(
+            model.config, sparsity_config=sparsity_config,
+            max_position_embeddings=max_position,
+            attention_probs_dropout_prob=0.0)
+        new_model = BertForPreTraining(cfg)
+        new_params = params
+        if params is not None:
+            pos = params["embeddings"]["position_embeddings"]
+            if max_position > pos.shape[0]:
+                import jax
+
+                new_params = jax.tree_util.tree_map(lambda x: x, params)
+                new_params["embeddings"] = dict(
+                    params["embeddings"],
+                    position_embeddings=SparseAttentionUtils
+                    .extend_position_embedding(pos, max_position))
+        return new_model, new_params
+
+    @staticmethod
+    def replace_self_attention_layer_with_sparse_self_attention_layer(
+            layer_config, sparsity_config):
+        """Reference :123-150, layer granularity: a DeepSpeedTransformerConfig
+        whose attention core is the block-sparse kernel (same param names, so
+        existing layer params load unchanged)."""
+        import copy
+
+        new_cfg = copy.copy(layer_config)
+        new_cfg.sparsity_config = sparsity_config
+        new_cfg.attn_dropout_ratio = 0.0
+        return new_cfg
 
     @staticmethod
     def pad_to_block_size(block_size: int, input_ids=None, attention_mask=None,

@@ -56,17 +56,29 @@ def block_sparse_attention(q, k, v, layout, block: int,
     import jax.numpy as jnp
 
     if use_pallas is None:
-        use_pallas = (rpe is None and key_padding_mask is None
-                      and attn_mask is None
+        # key padding rides the kernel as an in-kernel additive bias; only
+        # rpe / full attn_mask (dense S x S structures) force the XLA path
+        use_pallas = (rpe is None and attn_mask is None
                       and jax.default_backend() == "tpu"
                       and q.shape[2] % block == 0)
     if use_pallas:
         from deepspeed_tpu.ops.sparse_attention.block_sparse_kernel import \
             pallas_block_sparse_attention
 
-        assert rpe is None and key_padding_mask is None and attn_mask is None
+        assert rpe is None and attn_mask is None
+        key_bias = None
+        if key_padding_mask is not None:
+            kpm = jnp.asarray(key_padding_mask, jnp.float32)
+            if key_padding_mask_mode == "mul":
+                key_bias = jnp.where(kpm != 0, 0.0, -1e30)
+            elif key_padding_mask_mode == "add":
+                key_bias = kpm
+            else:
+                raise ValueError(
+                    f"unknown key_padding_mask_mode "
+                    f"{key_padding_mask_mode!r}")
         return pallas_block_sparse_attention(q, k, v, layout, block,
-                                             scale=scale)
+                                             scale=scale, key_bias=key_bias)
 
     B, H, S, D = q.shape
     nb = S // block

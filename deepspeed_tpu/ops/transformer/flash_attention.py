@@ -27,7 +27,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 # tuned on TPU v5e at (8, 16, 1024, 64): 512/1024 reached 22 TF fwd /
-# 45 TF fwd+bwd vs 13.6/25 for the fused-XLA jnp path (tools/flash_tune2.py);
+# 45 TF fwd+bwd vs 13.6/25 for the fused-XLA jnp path (tools/flash_tune.py);
 # blocks are clamped to the sequence length at call time
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
@@ -36,7 +36,21 @@ DEFAULT_BLOCK_K = 1024
 import os as _os
 _BWD_BLOCK_Q = int(_os.environ.get("DSTPU_FLASH_BWD_BLOCK_Q", "0"))
 _BWD_BLOCK_K = int(_os.environ.get("DSTPU_FLASH_BWD_BLOCK_K", "0"))
+# lse/delta wire format: by default they travel 128-lane broadcast
+# ((bh, s_q, 128), 127/128 of the bytes redundant — ~0.4 GB/tensor/layer at
+# the gpt2-350m bench shapes). DSTPU_FLASH_LSE2D=1 switches to compact
+# (bh, s_q) tiles with an in-kernel (1, bq) -> (bq, 1) relayout; flagged
+# (not default) until the on-chip sweep proves the Mosaic relayout cheap.
+_LSE_2D = _os.environ.get("DSTPU_FLASH_LSE2D", "0") == "1"
 NEG_INF = -1e30
+
+
+def _col(ref):
+    """Per-row statistic from its wire block: (1, bq) compact row ->
+    (bq, 1) column, or the legacy 128-lane block's first lane."""
+    if _LSE_2D:
+        return ref[...].reshape(-1, 1)
+    return ref[0][:, 0:1]
 
 
 def _dot(a, b, dims):
@@ -196,7 +210,10 @@ def _fwd_kernel(*refs, scale, causal, bias_kind, dropout_rate, s_k_total,
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
         lse = m_scr[:, 0:1] + jnp.log(l_safe)
-        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        if _LSE_2D:
+            lse_ref[...] = lse.reshape(lse_ref.shape)
+        else:
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _seed_ops(seed, dropout_rate):
@@ -234,11 +251,13 @@ def _flash_fwd(q, k, v, bias, seed, *, scale, causal, bias_kind, num_heads,
         ] + bias_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)) if _LSE_2D
+            else pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s_q, 128), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s_q) if _LSE_2D else (bh, s_q, 128),
+                                 jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -249,7 +268,7 @@ def _flash_fwd(q, k, v, bias, seed, *, scale, causal, bias_kind, num_heads,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*seed_ops, q, k, v, *bias_ops)
-    return out, lse[:, :, 0]
+    return out, (lse if _LSE_2D else lse[:, :, 0])
 
 
 # ---------------------------------------------------------------------------
@@ -288,8 +307,8 @@ def _bwd_dkdv_kernel(*refs, scale, causal, bias_kind, dropout_rate,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0][:, 0:1]                       # [bq, 1]
-        delta = delta_ref[0][:, 0:1]                   # [bq, 1]
+        lse = _col(lse_ref)                            # [bq, 1]
+        delta = _col(delta_ref)                        # [bq, 1]
         s = _dot(q, k, ((1,), (1,))) * scale                  # [bq, bk] f32
         s = _apply_bias(s, bias_ref, bias_kind)
         if causal:
@@ -353,8 +372,8 @@ def _bwd_dq_kernel(*refs, scale, causal, bias_kind, dropout_rate, s_k_total,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0][:, 0:1]
-        delta = delta_ref[0][:, 0:1]
+        lse = _col(lse_ref)
+        delta = _col(delta_ref)
         s = _dot(q, k, ((1,), (1,))) * scale
         s = _apply_bias(s, bias_ref, bias_kind)
         if causal:
@@ -385,7 +404,7 @@ def _flash_bwd(res, g, *, scale, causal, bias_kind, num_heads, dropout_rate,
     s_k = k.shape[1]
     # the backward sweeps accumulate into (block, d) fp32 scratch and run a
     # 5-matmul body — their best tile shape differs from the forward's;
-    # independent env knobs let tools/flash_tune2.py sweep them on-chip.
+    # independent env knobs let tools/flash_tune.py sweep them on-chip.
     # A knob with no 128-aligned divisor fails as loudly as the forward
     # does (flash_attention.py asserts in flash_attention()) — a partial
     # Pallas block would silently corrupt the gradients.
@@ -399,8 +418,22 @@ def _flash_bwd(res, g, *, scale, causal, bias_kind, num_heads, dropout_rate,
 
     # delta_i = rowsum(dO_i * O_i) — standard flash backward precompute
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
-    lse_w = jnp.broadcast_to(lse[:, :, None], (bh, s_q, 128)).astype(jnp.float32)
-    delta_w = jnp.broadcast_to(delta[:, :, None], (bh, s_q, 128))
+    if _LSE_2D:
+        lse_w = lse.astype(jnp.float32)                      # (bh, s_q)
+        delta_w = delta
+    else:
+        lse_w = jnp.broadcast_to(
+            lse[:, :, None], (bh, s_q, 128)).astype(jnp.float32)
+        delta_w = jnp.broadcast_to(delta[:, :, None], (bh, s_q, 128))
+
+    def stat_spec(index_q):
+        """BlockSpec for the lse/delta operands; index_q maps grid ids to
+        the q-block index."""
+        if _LSE_2D:
+            return pl.BlockSpec((1, block_q),
+                                lambda b, x, y: (b, index_q(x, y)))
+        return pl.BlockSpec((1, block_q, 128),
+                            lambda b, x, y: (b, index_q(x, y), 0))
 
     seed_ops, seed_specs = _seed_ops(seed, dropout_rate)
     # dkdv grid is (bh, k-block, q-block): bias maps transposed
@@ -418,8 +451,8 @@ def _flash_bwd(res, g, *, scale, causal, bias_kind, num_heads, dropout_rate,
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+            stat_spec(lambda j, i: i),
+            stat_spec(lambda j, i: i),
         ] + bias_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -453,8 +486,8 @@ def _flash_bwd(res, g, *, scale, causal, bias_kind, num_heads, dropout_rate,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+            stat_spec(lambda i, j: i),
+            stat_spec(lambda i, j: i),
         ] + bias_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),

@@ -58,7 +58,7 @@ class DeepSpeedTransformerConfig(TransformerConfig):
                  bf16=False, pre_layer_norm=True, normalize_invertible=False,
                  gelu_checkpoint=False, adjust_init_range=True,
                  attn_dropout_checkpoint=False, stochastic_mode=False,
-                 huggingface=False, training=True):
+                 huggingface=False, training=True, sparsity_config=None):
         super().__init__(
             batch_size, hidden_size,
             intermediate_size if intermediate_size > 0 else 4 * hidden_size,
@@ -79,6 +79,12 @@ class DeepSpeedTransformerConfig(TransformerConfig):
         self.attn_dropout_checkpoint = attn_dropout_checkpoint
         self.stochastic_mode = stochastic_mode
         self.huggingface = huggingface
+        # a SparsityConfig (ops/sparse_attention) routes the attention core
+        # through the block-sparse path — same params (QKV/out projections
+        # untouched), different attention pattern. The reference swaps
+        # whole modules (sparse_attention_utils.py:85-150); here the swap
+        # is this one config field.
+        self.sparsity_config = sparsity_config
 
     @classmethod
     def from_dict(cls, json_object):
@@ -148,10 +154,36 @@ class _EncoderBody(nn.Module):
         qh = mesh_lib.constrain(heads(q), head_sp)
         kh = mesh_lib.constrain(heads(k), head_sp)
         vh = mesh_lib.constrain(heads(v), head_sp)
-        ctx = scaled_dot_product_attention(
-            qh, kh, vh, causal=False, bias=attention_mask,
-            dropout_rng=drop_rng,
-            dropout_rate=cfg.attn_dropout_ratio if train else 0.0)
+        if cfg.sparsity_config is not None:
+            from deepspeed_tpu.ops.sparse_attention.sparse_self_attention \
+                import block_sparse_attention
+
+            assert drop_rng is None, (
+                "sparsity_config does not support attention dropout "
+                "(the reference's sparse path has none either); set "
+                "attn_dropout_ratio=0")
+            # HF extended additive mask (B,1,1,S) -> per-key additions;
+            # anything with per-query structure cannot collapse to a key
+            # bias and must fail loudly, not attend wrongly
+            kpm = None
+            if attention_mask is not None:
+                assert attention_mask.shape[1] == 1 \
+                    and attention_mask.shape[2] == 1, (
+                        "sparsity_config supports key-padding masks "
+                        "(B, 1, 1, S) only; got attention_mask shape "
+                        f"{attention_mask.shape} — per-query masks need "
+                        "the dense path (sparsity_config=None)")
+                kpm = attention_mask[:, 0, 0, :]
+            ctx = block_sparse_attention(
+                qh, kh, vh,
+                cfg.sparsity_config.make_layout(S),
+                cfg.sparsity_config.block,
+                key_padding_mask=kpm, key_padding_mask_mode="add")
+        else:
+            ctx = scaled_dot_product_attention(
+                qh, kh, vh, causal=False, bias=attention_mask,
+                dropout_rng=drop_rng,
+                dropout_rate=cfg.attn_dropout_ratio if train else 0.0)
         ctx = mesh_lib.constrain(ctx, P("data", "model", "seq", None))
         ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, E)
         attn_out = dense(E, "attn_out", out_std)(ctx)

@@ -1181,11 +1181,15 @@ class DeepSpeedEngine:
         never syncs."""
         if getattr(self, "_onebit_frozen_latch", False):
             return True
-        skipped = 0
-        if self.state is not None and self.fp16_enabled():
-            import jax
-
-            skipped = int(jax.device_get(self.state.skipped_steps))
+        # skipped >= 0, so while engine steps alone cannot reach the
+        # boundary there is nothing to read — keeps warmup free of
+        # host-device syncs until the freeze is actually reachable
+        if self.global_steps + 1 <= self.optimizer.freeze_step:
+            return False
+        # canonical counter (device counter + host-offload skips) — do not
+        # re-implement the read inline, the two would drift
+        skipped = self.skipped_steps \
+            if self.state is not None and self.fp16_enabled() else 0
         frozen = (self.global_steps - skipped + 1) > self.optimizer.freeze_step
         if frozen:
             self._onebit_frozen_latch = True

@@ -264,3 +264,30 @@ def test_dropout_gradients_multiblock():
             .astype(jnp.float32).sum()
 
     check_grads(f, (q, k, v), order=1, modes=["rev"], rtol=2e-2, atol=2e-2)
+
+
+def test_lse_compact_wire_format_matches(monkeypatch):
+    """DSTPU_FLASH_LSE2D=1 carries lse/delta as compact (bh, s_q) tiles
+    instead of 128-lane broadcasts; outputs and gradients must be
+    bit-identical to the legacy layout (it is pure wire format)."""
+    import deepspeed_tpu.ops.transformer.flash_attention as fa
+
+    rng = np.random.default_rng(21)
+    q = jnp.asarray(rng.standard_normal((1, 2, 256, 64)) * 0.3, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 2, 256, 64)) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 2, 256, 64)) * 0.3, jnp.float32)
+
+    def run():
+        def f(q, k, v):
+            return fa.flash_attention(
+                q, k, v, causal=True, block_q=128, block_k=128,
+                interpret=True).astype(jnp.float32).sum()
+        return f(q, k, v), jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    monkeypatch.setattr(fa, "_LSE_2D", False)
+    base_loss, base_g = run()
+    monkeypatch.setattr(fa, "_LSE_2D", True)
+    new_loss, new_g = run()
+    np.testing.assert_array_equal(np.asarray(base_loss), np.asarray(new_loss))
+    for a, b in zip(base_g, new_g):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -65,9 +65,12 @@ def main():
 
     def run(pipe):
         dp = n_dev // pipe
-        global_bs = micro * gas * dp
+        # keep the GLOBAL batch fixed across configs (micro grows as dp
+        # shrinks) so step times compare equal work, as documented
+        micro_p = micro * pipe
+        global_bs = micro_p * gas * dp
         ds = {"train_batch_size": global_bs,
-              "train_micro_batch_size_per_gpu": micro,
+              "train_micro_batch_size_per_gpu": micro_p,
               "gradient_accumulation_steps": gas,
               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
               "mesh": {"pipe": pipe, "data": dp},
@@ -76,7 +79,7 @@ def main():
             if pipe > 1 else GPT2Model(cfg)
         engine, _, _, _ = deepspeed_tpu.initialize(model=model,
                                                    config_params=ds)
-        ids = rng.integers(0, 256, (gas, micro * dp, args.seq))
+        ids = rng.integers(0, 256, (gas, micro_p * dp, args.seq))
         batch = {"input_ids": ids, "labels": ids.copy()}
         loss = engine.train_batch(batch=batch)       # compile
         float(jax.device_get(loss))

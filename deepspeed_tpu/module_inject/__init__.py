@@ -1,5 +1,5 @@
 from deepspeed_tpu.module_inject.replace_module import (
     inject_bert_layer_params, replace_bert_params, revert_bert_layer_params)
 from deepspeed_tpu.module_inject.policy import (
-    HFBertLayerPolicy, LayerPolicy, POLICY_REGISTRY, load_hf_gpt2_params,
-    register_policy, replace_module_params)
+    HFBertLayerPolicy, LayerPolicy, POLICY_REGISTRY, load_hf_bert_params,
+    load_hf_gpt2_params, register_policy, replace_module_params)

@@ -101,6 +101,76 @@ def _t(x):
     return x.T if x.ndim == 2 else x
 
 
+def _pad_rows(arr, multiple):
+    """Grow dim 0 with zero rows to the next `multiple` (MXU vocab
+    alignment) — shared by both HF loaders; the padded rows are inert
+    because the models slice/mask logits back to the true vocab."""
+    from deepspeed_tpu.models.api import pad_to_multiple
+
+    target = pad_to_multiple(arr.shape[0], multiple)
+    if target == arr.shape[0]:
+        return arr
+    pad_shape = (target - arr.shape[0],) + arr.shape[1:]
+    return np.concatenate([arr, np.zeros(pad_shape, arr.dtype)])
+
+
+def load_hf_bert_params(hf_params, config=None, pad_vocab_multiple=128):
+    """transformers FlaxBertForPreTraining params -> models/bert
+    BertForPreTraining params (fused DeepSpeedTransformerLayer encoder):
+    bring pretrained HF BERT weights into this framework. HF BERT is
+    post-LN, so pair with BertConfig(pre_layer_norm=False). Word embedding
+    and MLM bias grow zero pad rows to padded_vocab_size (MXU alignment;
+    logits are sliced back so the rows are inert).
+
+    Mirrors load_hf_gpt2_params below; the per-layer mapping is
+    replace_module.inject_bert_layer_params (the reference's
+    HFBertLayerPolicy, deepspeed/module_inject/inject.py:8-58)."""
+    from deepspeed_tpu.module_inject.replace_module import replace_bert_params
+
+    if config is not None:
+        pad_vocab_multiple = config.pad_vocab_multiple
+    if "cls" not in hf_params:
+        # unlike GPT-2 (everything under 'transformer'), the MLM/NSP heads
+        # live OUTSIDE the 'bert' subtree — a bare subtree cannot be loaded
+        raise KeyError(
+            "load_hf_bert_params needs the FULL FlaxBertForPreTraining "
+            "params ({'bert': ..., 'cls': ...}); the 'cls' prediction "
+            "heads are missing — pass hf_model.params, not a subtree")
+    t = hf_params["bert"]
+    cls = hf_params["cls"]
+    emb = t["embeddings"]
+    word = _pad_rows(np.asarray(emb["word_embeddings"]["embedding"]),
+                     pad_vocab_multiple)
+    mlm_bias = _pad_rows(np.asarray(cls["predictions"]["bias"]),
+                         pad_vocab_multiple)
+    transform = cls["predictions"]["transform"]
+    out = {
+        "embeddings": {
+            "word_embeddings": word,
+            "position_embeddings": np.asarray(
+                emb["position_embeddings"]["embedding"]),
+            "token_type_embeddings": np.asarray(
+                emb["token_type_embeddings"]["embedding"]),
+            "ln": {"scale": np.asarray(emb["LayerNorm"]["scale"]),
+                   "bias": np.asarray(emb["LayerNorm"]["bias"])},
+        },
+        # HF flax keys encoder layers by bare index ("0", "1", ...)
+        "encoder": replace_bert_params(t["encoder"]["layer"],
+                                       layer_pattern=r"^(\d+)$"),
+        "mlm_transform": {
+            "kernel": np.asarray(transform["dense"]["kernel"]),
+            "bias": np.asarray(transform["dense"]["bias"])},
+        "mlm_ln": {"scale": np.asarray(transform["LayerNorm"]["scale"]),
+                   "bias": np.asarray(transform["LayerNorm"]["bias"])},
+        "mlm_bias": mlm_bias,
+        "pooler": {"kernel": np.asarray(t["pooler"]["dense"]["kernel"]),
+                   "bias": np.asarray(t["pooler"]["dense"]["bias"])},
+        "nsp": {"kernel": np.asarray(cls["seq_relationship"]["kernel"]),
+                "bias": np.asarray(cls["seq_relationship"]["bias"])},
+    }
+    return out
+
+
 def load_hf_gpt2_params(hf_params, config=None, pad_vocab_multiple=128):
     """transformers FlaxGPT2LMHeadModel params -> models/gpt2.GPT2LMHead
     params (non-scan layout): bring pretrained HF GPT-2 weights into this
@@ -113,17 +183,10 @@ def load_hf_gpt2_params(hf_params, config=None, pad_vocab_multiple=128):
     model will init (a config with pad_vocab_multiple=0 or a non-default
     multiple must not meet a 128-padded table); pad_vocab_multiple is the
     fallback when no config is given."""
-    from deepspeed_tpu.models.api import pad_to_multiple
-
     if config is not None:
         pad_vocab_multiple = config.pad_vocab_multiple
     t = hf_params.get("transformer", hf_params)
-    wte = np.asarray(t["wte"]["embedding"])
-    target = pad_to_multiple(wte.shape[0], pad_vocab_multiple)
-    if target > wte.shape[0]:
-        wte = np.concatenate(
-            [wte, np.zeros((target - wte.shape[0], wte.shape[1]),
-                           wte.dtype)])
+    wte = _pad_rows(np.asarray(t["wte"]["embedding"]), pad_vocab_multiple)
     out = {
         "wte": wte,
         "wpe": np.asarray(t["wpe"]["embedding"]),

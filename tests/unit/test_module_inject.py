@@ -163,3 +163,41 @@ def test_hf_gpt2_weights_load_and_match_logits():
     got = np.asarray(ours.module.apply({"params": params},
                                        jnp.asarray(ids), train=False))
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_bert_weights_load_and_match_logits():
+    """Pretrained-HF-BERT interop: convert FlaxBertForPreTraining params
+    into our fused-layer BertForPreTraining and require matching MLM + NSP
+    logits on the same input (post-LN, exact-gelu path)."""
+    transformers = pytest.importorskip("transformers")
+    from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining
+    from deepspeed_tpu.module_inject.policy import load_hf_bert_params
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=32,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    hf = transformers.FlaxBertForPreTraining(hf_cfg, seed=0)
+
+    ours = BertForPreTraining(BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=32, dtype=jnp.float32,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        pre_layer_norm=False))
+    params = load_hf_bert_params(hf.params)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, (2, 16))
+    mask = np.ones((2, 16), np.int32)
+    ref = hf(jnp.asarray(ids), attention_mask=jnp.asarray(mask))
+    got_mlm, got_nsp = ours.module.apply(
+        {"params": params}, jnp.asarray(ids), jnp.asarray(mask),
+        train=False)
+    np.testing.assert_allclose(np.asarray(got_mlm),
+                               np.asarray(ref.prediction_logits),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(got_nsp),
+                               np.asarray(ref.seq_relationship_logits),
+                               rtol=2e-4, atol=2e-4)

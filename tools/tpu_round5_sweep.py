@@ -39,11 +39,46 @@ def probe(timeout_s=300):
         return False
 
 
+_REHEARSAL = False
+
+
+def _shrink(args_list):
+    """Rehearsal: tiny shapes, 2 steps, CPU backend allowed."""
+    out = list(args_list)
+
+    def setval(flag, v):
+        if flag in out:
+            out[out.index(flag) + 1] = str(v)
+
+    setval("--seq", 128)
+    setval("--batch", 1)
+    setval("--steps", 2)
+    for flag, v in (("--allow_cpu", "1"), ("--budget_s", "500")):
+        if flag in out:
+            setval(flag, v)
+        else:
+            out += [flag, v]
+    # big models would still crawl on CPU even at tiny shapes
+    if "--model" in out:
+        i = out.index("--model") + 1
+        if out[i].startswith("gpt2") and out[i] != "gpt2-125m":
+            out[i] = "gpt2-125m"
+        if out[i] == "bert-large":
+            out[i] = "bert-base"
+    return out
+
+
 def run_one(log, name, args_list, timeout_s, env_extra=None):
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     if env_extra:
         env.update(env_extra)
+    if _REHEARSAL:
+        # belt and braces with the worker's own --allow_cpu override: no
+        # rehearsal subprocess may ever touch the (possibly dead) tunnel
+        env["JAX_PLATFORMS"] = "cpu"
+        args_list = _shrink(args_list)
+        timeout_s = 600
     cmd = [sys.executable, os.path.join(REPO, "bench.py"),
            "--single-attempt"] + args_list
     t0 = time.time()
@@ -80,9 +115,16 @@ def main():
     p.add_argument("--log", default="/tmp/r5_sweep.jsonl")
     p.add_argument("--probe-timeout", type=int, default=300)
     p.add_argument("--skip-probe", action="store_true")
+    p.add_argument("--cpu-rehearsal", action="store_true",
+                   help="dry-run the whole campaign on the CPU backend with "
+                        "tiny shapes: validates the flag plumbing and log "
+                        "mining before spending real tunnel time")
     args = p.parse_args()
 
-    if not args.skip_probe and not probe(args.probe_timeout):
+    if args.cpu_rehearsal:
+        global _REHEARSAL
+        _REHEARSAL = True
+    elif not args.skip_probe and not probe(args.probe_timeout):
         print("TPU backend not answering; aborting (re-run when the tunnel "
               "is back)", file=sys.stderr)
         return 1

@@ -91,12 +91,14 @@ def run_one(log, name, args_list, timeout_s, env_extra=None):
         except ValueError:
             parsed = None
         entry = {"name": name, "args": args_list, "env": env_extra,
+                 "rehearsal": _REHEARSAL,
                  "rc": r.returncode, "elapsed_s": round(time.time() - t0, 1),
                  "result": parsed,
                  "stderr_tail": r.stderr.strip().splitlines()[-3:]
                  if parsed is None else None}
     except subprocess.TimeoutExpired:
         entry = {"name": name, "args": args_list, "env": env_extra,
+                 "rehearsal": _REHEARSAL,
                  "rc": "timeout", "elapsed_s": round(time.time() - t0, 1),
                  "result": None}
     with open(log, "a") as f:
@@ -124,6 +126,9 @@ def main():
     if args.cpu_rehearsal:
         global _REHEARSAL
         _REHEARSAL = True
+        if args.log == p.get_default("log"):
+            # never mix throwaway CPU numbers into the real campaign log
+            args.log = "/tmp/r5_rehearsal.jsonl"
     elif not args.skip_probe and not probe(args.probe_timeout):
         print("TPU backend not answering; aborting (re-run when the tunnel "
               "is back)", file=sys.stderr)

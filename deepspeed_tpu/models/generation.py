@@ -65,15 +65,56 @@ def _attn_decode(x, p, cache_k, cache_v, pos, cfg):
     return _dense(y, p["c_proj"]), cache_k, cache_v
 
 
+def _moe_ffn(x, mp, cfg):
+    """Params-level MoE FFN for generation — the same dense top-k gating +
+    stacked-expert einsums the training layer runs (moe/sharded_moe.py),
+    deterministic (no jitter), gated with cfg.moe_capacity_factor exactly
+    like the train=False forward (GPT-2's blocks do not set an eval
+    capacity factor). x: (B, T, M).
+
+    Capacity semantics: prefill gates the whole prompt per batch row
+    exactly like the training forward; decode gates ONE token per step, so
+    a decoded token never competes with its predecessors for expert slots
+    (the min_capacity floor guarantees it a slot). Identical to the
+    training forward whenever nothing drops; under capacity pressure
+    decode keeps tokens the training pass would drop."""
+    from deepspeed_tpu.moe.sharded_moe import top_k_gating
+
+    dtype = x.dtype
+    logits = x.astype(jnp.float32) @ mp["router"]["kernel"]    # (B, T, E)
+    combine, dispatch, _, _ = top_k_gating(
+        logits, k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor)
+    ex = mp["experts"]
+    E = cfg.moe_num_experts
+    d = jnp.einsum("gsec,gsm->egcm", dispatch.astype(dtype), x)
+    B, C = d.shape[1], d.shape[2]
+    d = d.reshape(E, B * C, -1)
+    h = jnp.einsum("enm,emf->enf", d, ex["w_in"].astype(dtype)) \
+        + ex["b_in"].astype(dtype)[:, None, :]
+    h = jax.nn.gelu(h, approximate=True)
+    y = jnp.einsum("enf,efm->enm", h, ex["w_out"].astype(dtype)) \
+        + ex["b_out"].astype(dtype)[:, None, :]
+    y = y.reshape(E, B, C, -1)
+    # dropped tokens get zero here and ride the residual, like training
+    return jnp.einsum("egcm,gsec->gsm", y, combine.astype(dtype))
+
+
+def _ffn(x, bp, cfg):
+    """Dense-MLP or MoE feed-forward, keyed on the block's param names."""
+    if "moe" in bp:
+        return _moe_ffn(x, bp["moe"], cfg)
+    mp = bp["mlp"]
+    h = jax.nn.gelu(_dense(x, mp["c_fc"]), approximate=True)
+    return _dense(h, mp["c_proj"])
+
+
 def _block_decode(x, bp, ck, cv, pos, cfg):
     a, ck, cv = _attn_decode(
         _ln(x, bp["ln_1"], cfg.layer_norm_epsilon), bp["attn"], ck, cv,
         pos, cfg)
     x = x + a
     h = _ln(x, bp["ln_2"], cfg.layer_norm_epsilon)
-    mp = bp["mlp"]
-    h = jax.nn.gelu(_dense(h, mp["c_fc"]), approximate=True)
-    x = x + _dense(h, mp["c_proj"])
+    x = x + _ffn(h, bp, cfg)
     return x, ck, cv
 
 
@@ -111,8 +152,7 @@ def _prefill(params, cfg, tokens):
             _ln(x, bp["ln_1"], cfg.layer_norm_epsilon), bp["attn"], cfg)
         x = x + a
         h = _ln(x, bp["ln_2"], cfg.layer_norm_epsilon)
-        h = jax.nn.gelu(_dense(h, bp["mlp"]["c_fc"]), approximate=True)
-        x = x + _dense(h, bp["mlp"]["c_proj"])
+        x = x + _ffn(h, bp, cfg)
         ks.append(k)
         vs.append(v)
     x = _ln(x, params["ln_f"], cfg.layer_norm_epsilon)
@@ -142,29 +182,51 @@ def _forward_token(params, cfg, token, pos, caches_k, caches_v):
         jnp.stack(new_k), jnp.stack(new_v)
 
 
-def _sample(logits, key, temperature, top_k):
+def _sample(logits, key, temperature, top_k, top_p=0.0):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
-    if top_k and top_k < logits.shape[-1]:
-        # top_k >= vocab filters nothing; clamping keeps the arg safe
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, -1e30, logits)
+    use_k = top_k and top_k < logits.shape[-1]
+    use_p = top_p and top_p < 1.0
+    if use_k or use_p:
+        # ONE descending sort serves both filters (this runs per decode
+        # step inside the scan — no reason to sort twice)
+        sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        if use_k:
+            # top_k >= vocab filters nothing; clamping keeps the arg safe
+            logits = jnp.where(
+                logits < sorted_desc[:, top_k - 1][:, None], -1e30, logits)
+        if use_p:
+            # nucleus sampling: keep the smallest prefix of the sorted
+            # distribution whose mass reaches top_p (the top token always
+            # survives — its EXCLUSIVE cumulative mass is 0 < top_p).
+            # With top_k also active, masked tokens carry ~0 probability
+            # here, so the nucleus is computed within the top-k set.
+            if use_k:
+                sorted_desc = jnp.where(
+                    sorted_desc < sorted_desc[:, top_k - 1][:, None],
+                    -1e30, sorted_desc)
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            exclusive = jnp.cumsum(probs, axis=-1) - probs
+            keep = exclusive < top_p
+            cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf),
+                             axis=-1, keepdims=True)
+            logits = jnp.where(logits >= cutoff, logits, -1e30)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
 def generate(model, params, input_ids, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
-             rng=None):
+             top_p: float = 0.0, rng=None):
     """Generate `max_new_tokens` continuations. input_ids: (B, S0) int.
-    temperature 0 = greedy. Returns (B, S0 + max_new_tokens) int32.
+    temperature 0 = greedy; top_k / top_p (nucleus) filter the sampling
+    distribution and compose (top_k first). Returns
+    (B, S0 + max_new_tokens) int32.
 
     The prompt is consumed by ONE batched causal forward (prefill) that
     seeds the KV cache; decode then scans one token at a time.
     """
     cfg = model.config
-    assert not cfg.moe_num_experts, \
-        "generate() does not support MoE configs yet (dense blocks only)"
     input_ids = jnp.asarray(input_ids, jnp.int32)
     if max_new_tokens <= 0:
         return np.asarray(input_ids)
@@ -180,14 +242,15 @@ def generate(model, params, input_ids, max_new_tokens: int,
     # cfg is a frozen (hashable) dataclass, so the decode program caches
     # per (config, shapes, sampling) — repeat generate() calls reuse the
     # compiled scan instead of re-tracing a fresh closure
-    run = _decode_fn(cfg, S0, S_max, float(temperature), int(top_k or 0))
+    run = _decode_fn(cfg, S0, S_max, float(temperature), int(top_k or 0),
+                     float(top_p or 0.0))
     out = run(params, input_ids, caches_k, caches_v, key)
     seq = jnp.concatenate([input_ids, jnp.transpose(out)], axis=1)
     return np.asarray(seq)
 
 
 @functools.lru_cache(maxsize=32)
-def _decode_fn(cfg, S0, S_max, temperature, top_k):
+def _decode_fn(cfg, S0, S_max, temperature, top_k, top_p=0.0):
     def run(params, tokens_in, caches_k, caches_v, key):
         # batched prefill over the prompt seeds positions [0, S0)
         logits0, pk, pv = _prefill(params, cfg, tokens_in)
@@ -196,13 +259,13 @@ def _decode_fn(cfg, S0, S_max, temperature, top_k):
         caches_v = jax.lax.dynamic_update_slice(
             caches_v, pv, (0, 0, 0, 0, 0))
         first = _sample(logits0, jax.random.fold_in(key, S0 - 1),
-                        temperature, top_k)
+                        temperature, top_k, top_p)
 
         def step(carry, pos):
             tok, ck, cv = carry
             logits, ck, cv = _forward_token(params, cfg, tok, pos, ck, cv)
             nxt = _sample(logits, jax.random.fold_in(key, pos),
-                          temperature, top_k)
+                          temperature, top_k, top_p)
             return (nxt, ck, cv), nxt
 
         # decode steps consume tokens at positions S0 .. S_max-2

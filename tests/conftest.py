@@ -82,7 +82,8 @@ _SLOW_TESTS = {
         "test_flash_multiblock_causal_grad"},
     "test_generation.py": {
         "test_greedy_generation_matches_transformers",
-        "test_greedy_matches_full_forward"},
+        "test_greedy_matches_full_forward",
+        "test_moe_generation_matches_training_forward"},
     "test_moe.py": {
         "test_eval_capacity_factor", "test_gpt2_moe_trains_on_engine",
         "test_moe_elastic_checkpoint_dp8_to_dp4",

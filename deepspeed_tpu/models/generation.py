@@ -82,8 +82,13 @@ def _moe_ffn(x, mp, cfg):
 
     dtype = x.dtype
     logits = x.astype(jnp.float32) @ mp["router"]["kernel"]    # (B, T, E)
+    # single-token decode groups occupy at most one slot per chosen expert:
+    # capacity=k is exact, and skips the min_capacity=4 floor that would
+    # oversize the expert GEMMs 2-4x per generated token
+    cap = cfg.moe_top_k if x.shape[1] == 1 else None
     combine, dispatch, _, _ = top_k_gating(
-        logits, k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor)
+        logits, k=cfg.moe_top_k, capacity=cap,
+        capacity_factor=cfg.moe_capacity_factor)
     ex = mp["experts"]
     E = cfg.moe_num_experts
     d = jnp.einsum("gsec,gsm->egcm", dispatch.astype(dtype), x)

@@ -136,9 +136,6 @@ def test_moe_generation_matches_training_forward():
     over the training forward, given capacity generous enough that neither
     path drops tokens (drop competition is the one documented divergence —
     decode gates one token per step; see generation._moe_ffn)."""
-    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
-    from deepspeed_tpu.models.generation import generate
-
     cfg = GPT2Config(vocab_size=97, n_positions=32, n_embd=32, n_layer=4,
                      n_head=2, dtype=jnp.float32, loss_chunk_tokens=0,
                      moe_num_experts=4, moe_top_k=2,

@@ -222,10 +222,11 @@ def _sample(logits, key, temperature, top_k, top_p=0.0):
 
 def generate(model, params, input_ids, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
-             top_p: float = 0.0, rng=None):
+             top_p: float = 0.0, rng=None, num_beams: int = 1):
     """Generate `max_new_tokens` continuations. input_ids: (B, S0) int.
     temperature 0 = greedy; top_k / top_p (nucleus) filter the sampling
-    distribution and compose (top_k first). Returns
+    distribution and compose (top_k first); num_beams > 1 switches to
+    beam search (deterministic — incompatible with sampling). Returns
     (B, S0 + max_new_tokens) int32.
 
     The prompt is consumed by ONE batched causal forward (prefill) that
@@ -235,6 +236,12 @@ def generate(model, params, input_ids, max_new_tokens: int,
     input_ids = jnp.asarray(input_ids, jnp.int32)
     if max_new_tokens <= 0:
         return np.asarray(input_ids)
+    if num_beams > 1:
+        assert temperature == 0.0 and not top_k and not top_p \
+            and rng is None, \
+            "beam search is deterministic; drop temperature/top_k/top_p/rng"
+        return generate_beam(model, params, input_ids, max_new_tokens,
+                             num_beams=num_beams)
     B, S0 = input_ids.shape
     S_max = S0 + max_new_tokens
     assert S_max <= cfg.n_positions, \
@@ -252,6 +259,82 @@ def generate(model, params, input_ids, max_new_tokens: int,
     out = run(params, input_ids, caches_k, caches_v, key)
     seq = jnp.concatenate([input_ids, jnp.transpose(out)], axis=1)
     return np.asarray(seq)
+
+
+def generate_beam(model, params, input_ids, max_new_tokens: int,
+                  num_beams: int = 4):
+    """Beam-search decode: return the highest-log-probability continuation
+    among `num_beams` beams per batch row. input_ids: (B, S0) int; returns
+    (B, S0 + max_new_tokens) int32.
+
+    Fixed-length search (no EOS concept in this API), whole loop in ONE
+    jitted lax.scan: beams live as a (B*W) batch sharing the KV-cache
+    machinery of greedy decode, and each step's top-W reselection reorders
+    the caches by gathering along the beam dim. num_beams=1 is exactly
+    greedy decode."""
+    cfg = model.config
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    if max_new_tokens <= 0:
+        return np.asarray(input_ids)
+    B, S0 = input_ids.shape
+    W = int(num_beams)
+    assert W >= 1
+    S_max = S0 + max_new_tokens
+    assert S_max <= cfg.n_positions, \
+        f"{S_max} exceeds n_positions={cfg.n_positions}"
+    run = _beam_fn(cfg, S0, S_max, W)
+    seq = run(params, input_ids)
+    return np.asarray(seq)
+
+
+@functools.lru_cache(maxsize=32)
+def _beam_fn(cfg, S0, S_max, W):
+    T = S_max - S0
+
+    def run(params, tokens_in):
+        B = tokens_in.shape[0]
+        logits0, pk, pv = _prefill(params, cfg, tokens_in)   # (B,V), (L,B,H,S0,D)
+        logp0 = jax.nn.log_softmax(logits0, axis=-1)         # (B, V)
+        V = logp0.shape[-1]
+        # seed beams with the prompt's top-W continuations
+        scores, first = jax.lax.top_k(logp0, W)              # (B, W)
+        # tile caches to (L, B*W, H, S_max, D), beam-major within batch
+        def tile(c):
+            c = jnp.pad(c, ((0, 0), (0, 0), (0, 0), (0, S_max - S0), (0, 0)))
+            c = jnp.repeat(c, W, axis=1)
+            return c
+        ck, cv = tile(pk), tile(pv)
+        toks = jnp.zeros((B, W, T), jnp.int32)
+        toks = toks.at[:, :, 0].set(first)
+        flat = lambda x: x.reshape(B * W)
+
+        def step(carry, pos):
+            toks, scores, ck, cv, prev = carry
+            logits, ck, cv = _forward_token(params, cfg, flat(prev), pos,
+                                            ck, cv)          # (B*W, V)
+            logp = jax.nn.log_softmax(logits, axis=-1).reshape(B, W, V)
+            cand = scores[:, :, None] + logp                 # (B, W, V)
+            scores, idx = jax.lax.top_k(cand.reshape(B, W * V), W)
+            parent = idx // V                                # (B, W)
+            nxt = (idx % V).astype(jnp.int32)
+            # reorder beam state by parent: tokens-so-far and KV caches
+            toks = jnp.take_along_axis(toks, parent[:, :, None], axis=1)
+            toks = toks.at[:, :, pos - S0 + 1].set(nxt)
+            gather = (jnp.arange(B)[:, None] * W + parent).reshape(-1)
+            ck = jnp.take(ck, gather, axis=1)
+            cv = jnp.take(cv, gather, axis=1)
+            return (toks, scores, ck, cv, nxt), None
+
+        if T > 1:
+            (toks, scores, _, _, _), _ = jax.lax.scan(
+                step, (toks, scores, ck, cv, first),
+                jnp.arange(S0, S_max - 1))
+        best = jnp.argmax(scores, axis=-1)                   # (B,)
+        out = jnp.take_along_axis(
+            toks, best[:, None, None], axis=1)[:, 0]         # (B, T)
+        return jnp.concatenate([tokens_in, out], axis=1)
+
+    return jax.jit(run)
 
 
 @functools.lru_cache(maxsize=32)

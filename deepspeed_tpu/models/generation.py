@@ -222,12 +222,18 @@ def _sample(logits, key, temperature, top_k, top_p=0.0):
 
 def generate(model, params, input_ids, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
-             top_p: float = 0.0, rng=None, num_beams: int = 1):
+             top_p: float = 0.0, rng=None, num_beams: int = 1,
+             eos_token_id: Optional[int] = None):
     """Generate `max_new_tokens` continuations. input_ids: (B, S0) int.
     temperature 0 = greedy; top_k / top_p (nucleus) filter the sampling
     distribution and compose (top_k first); num_beams > 1 switches to
     beam search (deterministic — incompatible with sampling). Returns
     (B, S0 + max_new_tokens) int32.
+
+    eos_token_id: rows that emit it stop — every later position repeats
+    the eos id. The program stays fixed-shape (the scan always runs
+    max_new_tokens steps; finished rows just carry eos), which is the
+    TPU-friendly formulation of early stopping.
 
     The prompt is consumed by ONE batched causal forward (prefill) that
     seeds the KV cache; decode then scans one token at a time.
@@ -240,6 +246,10 @@ def generate(model, params, input_ids, max_new_tokens: int,
         assert temperature == 0.0 and not top_k and not top_p \
             and rng is None, \
             "beam search is deterministic; drop temperature/top_k/top_p/rng"
+        assert eos_token_id is None, \
+            "beam search is fixed-length; eos_token_id is not supported " \
+            "with num_beams > 1 (length-normalized eos-aware scoring is a " \
+            "different search)"
         return generate_beam(model, params, input_ids, max_new_tokens,
                              num_beams=num_beams)
     B, S0 = input_ids.shape
@@ -255,7 +265,8 @@ def generate(model, params, input_ids, max_new_tokens: int,
     # per (config, shapes, sampling) — repeat generate() calls reuse the
     # compiled scan instead of re-tracing a fresh closure
     run = _decode_fn(cfg, S0, S_max, float(temperature), int(top_k or 0),
-                     float(top_p or 0.0))
+                     float(top_p or 0.0),
+                     int(eos_token_id) if eos_token_id is not None else -1)
     out = run(params, input_ids, caches_k, caches_v, key)
     seq = jnp.concatenate([input_ids, jnp.transpose(out)], axis=1)
     return np.asarray(seq)
@@ -338,7 +349,7 @@ def _beam_fn(cfg, S0, S_max, W):
 
 
 @functools.lru_cache(maxsize=32)
-def _decode_fn(cfg, S0, S_max, temperature, top_k, top_p=0.0):
+def _decode_fn(cfg, S0, S_max, temperature, top_k, top_p=0.0, eos=-1):
     def run(params, tokens_in, caches_k, caches_v, key):
         # batched prefill over the prompt seeds positions [0, S0)
         logits0, pk, pv = _prefill(params, cfg, tokens_in)
@@ -348,17 +359,24 @@ def _decode_fn(cfg, S0, S_max, temperature, top_k, top_p=0.0):
             caches_v, pv, (0, 0, 0, 0, 0))
         first = _sample(logits0, jax.random.fold_in(key, S0 - 1),
                         temperature, top_k, top_p)
+        done0 = first == eos if eos >= 0 else jnp.zeros_like(first, bool)
 
         def step(carry, pos):
-            tok, ck, cv = carry
+            tok, done, ck, cv = carry
             logits, ck, cv = _forward_token(params, cfg, tok, pos, ck, cv)
             nxt = _sample(logits, jax.random.fold_in(key, pos),
                           temperature, top_k, top_p)
-            return (nxt, ck, cv), nxt
+            if eos >= 0:
+                # finished rows keep emitting eos; the cache still advances
+                # (harmless — nothing attends past a row's eos in the
+                # returned sequence)
+                nxt = jnp.where(done, jnp.int32(eos), nxt)
+                done = done | (nxt == eos)
+            return (nxt, done, ck, cv), nxt
 
         # decode steps consume tokens at positions S0 .. S_max-2
-        (_, _, _), rest = jax.lax.scan(
-            step, (first, caches_k, caches_v),
+        (_, _, _, _), rest = jax.lax.scan(
+            step, (first, done0, caches_k, caches_v),
             jnp.arange(S0, S_max - 1))
         return jnp.concatenate([first[None], rest], axis=0)  # (new, B)
 

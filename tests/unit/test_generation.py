@@ -224,3 +224,25 @@ def test_beam_search_finds_higher_likelihood():
 
     g, b = seq_logp(greedy), seq_logp(beam)
     assert (b >= g - 0.5).all(), (b, g)
+
+
+def test_eos_latches_and_pads():
+    """Once a row emits eos, every later position must repeat eos; rows
+    that never emit it are unaffected (identical to the no-eos run)."""
+    model, params = _model(False)
+    prompt = np.random.default_rng(12).integers(0, 97, (3, 4))
+    base = generate(model, params, prompt, max_new_tokens=8)
+    # pick an eos id that appears mid-continuation for at least one row
+    eos = int(base[0, 4 + 2])
+    out = generate(model, params, prompt, max_new_tokens=8,
+                   eos_token_id=eos)
+    for b in range(3):
+        row = out[b, 4:]
+        hits = np.flatnonzero(row == eos)
+        if hits.size:
+            first = hits[0]
+            assert (row[first:] == eos).all(), row
+            # tokens before the first eos match the unconstrained run
+            np.testing.assert_array_equal(row[:first], base[b, 4:4 + first])
+        else:
+            np.testing.assert_array_equal(row, base[b, 4:])

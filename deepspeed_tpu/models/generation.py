@@ -242,6 +242,9 @@ def generate(model, params, input_ids, max_new_tokens: int,
     input_ids = jnp.asarray(input_ids, jnp.int32)
     if max_new_tokens <= 0:
         return np.asarray(input_ids)
+    # a sign/range bug here would otherwise mask EVERY logit and emit
+    # plausible-shaped garbage (token 0 forever) with no error
+    assert 0.0 <= (top_p or 0.0) <= 1.0, f"top_p must be in [0, 1]: {top_p}"
     if num_beams > 1:
         assert temperature == 0.0 and not top_k and not top_p \
             and rng is None, \
@@ -290,6 +293,9 @@ def generate_beam(model, params, input_ids, max_new_tokens: int,
     B, S0 = input_ids.shape
     W = int(num_beams)
     assert W >= 1
+    assert W <= model.config.vocab_size, \
+        f"num_beams={W} exceeds vocab_size={model.config.vocab_size}; " \
+        f"top-k reselection cannot produce more beams than tokens"
     S_max = S0 + max_new_tokens
     assert S_max <= cfg.n_positions, \
         f"{S_max} exceeds n_positions={cfg.n_positions}"

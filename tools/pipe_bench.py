@@ -92,18 +92,28 @@ def main():
         out = {"pipe": pipe, "dp": dp, "gas": gas,
                "global_batch": global_bs, "step_ms": round(step_ms, 2)}
         if pipe > 1:
-            # schedule shape + host enqueue cost per instruction
-            sch = sched_lib.TrainSchedule(micro_batches=gas, stages=pipe,
-                                          stage_id=0)
-            n_instr = sum(len(step) for step in sch.steps()) * pipe
-            noop = jax.jit(lambda x: x)
-            x = jax.device_put(np.zeros((1,), np.float32))
-            noop(x)                                   # compile
+            # schedule shape: EXACT per-stage instruction streams (first/
+            # last stages omit recv/send legs, so stage 0 x pipe would
+            # overcount); host enqueue cost timed against each stage's
+            # actual submesh device
+            n_instr = sum(
+                sum(len(step) for step in sched_lib.TrainSchedule(
+                    micro_batches=gas, stages=pipe, stage_id=s).steps())
+                for s in range(pipe))
+            devs = [m.devices.flat[0] for m in engine._submeshes] \
+                if hasattr(engine, "_submeshes") else [jax.devices()[0]]
+            reps = 200 // len(devs)
+            noop = jax.jit(lambda x: x)   # placement follows the input
+            noops = []
+            for d in devs:
+                x = jax.device_put(np.zeros((1,), np.float32), d)
+                noop(x)                                   # compile/warm
+                noops.append((noop, x))
             t0 = time.time()
-            reps = 200
             for _ in range(reps):
-                noop(x)
-            enqueue_us = (time.time() - t0) / reps * 1e6
+                for noop, x in noops:
+                    noop(x)
+            enqueue_us = (time.time() - t0) / (reps * len(devs)) * 1e6
             bubble = (pipe - 1) / (gas + pipe - 1)
             out.update({
                 "instructions_per_step": n_instr,

@@ -1,10 +1,21 @@
 """Checkpoint serialization helpers shared by the engines.
 
-np.savez stores ml_dtypes arrays (bfloat16, float8_*) as raw void ('|V2')
-and np.load cannot interpret them — each leaf's dtype name is recorded
-alongside and void payloads are re-viewed through ml_dtypes on load
-(bit-exact round trip)."""
+ml_dtypes arrays (bfloat16, float8_*) are not portable through np.savez
+as-is: kind-'V' dtypes land as raw void that np.load returns uninterpreted,
+and kind-'f' extension dtypes (float8_e5m2) write a descr like '<f1' that
+np.load REJECTS ("not a valid dtype descriptor") — a checkpoint that can
+never be read back.  So every non-builtin dtype is stored as a void view of
+its bytes with the dtype name recorded alongside, and re-viewed through
+ml_dtypes on load (bit-exact round trip)."""
 import numpy as np
+
+
+def _storable(arr):
+    """View non-builtin (ml_dtypes) arrays as void bytes so np.load can
+    always parse the saved descr."""
+    if arr.dtype.isbuiltin != 1:
+        return arr.view(np.dtype(f"V{arr.dtype.itemsize}"))
+    return arr
 
 
 def leaves_to_npz_dict(flat_leaves):
@@ -12,7 +23,7 @@ def leaves_to_npz_dict(flat_leaves):
     out = {}
     for i, leaf in enumerate(flat_leaves):
         arr = np.asarray(leaf)
-        out[f"leaf_{i}"] = arr
+        out[f"leaf_{i}"] = _storable(arr)
         out[f"dtype_{i}"] = np.str_(str(arr.dtype))
     return out
 
@@ -34,7 +45,7 @@ def npz_dict_to_leaves(data):
 def named_leaf_entry(name, leaf):
     """One name-keyed npz entry (+ dtype sidecar for ml_dtypes payloads)."""
     arr = np.asarray(leaf)
-    return {name: arr, f"dtype::{name}": np.str_(str(arr.dtype))}
+    return {name: _storable(arr), f"dtype::{name}": np.str_(str(arr.dtype))}
 
 
 def named_leaf_lookup(data, name):

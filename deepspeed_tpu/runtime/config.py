@@ -273,6 +273,48 @@ def get_mesh_shape(param_dict):
     return shape
 
 
+class DeepSpeedResilienceConfig:
+    """"resilience" ds_config section: atomic checkpoints + watchdog.
+
+    Everything defaults safe-and-on for the commit path (atomic, fsync,
+    verify) and off for the opt-in behaviors (auto-resume, watchdog,
+    retention GC).
+    """
+
+    def __init__(self, param_dict):
+        d = param_dict.get(RESILIENCE, {})
+        wd = d.get(RESILIENCE_WATCHDOG, {})
+        self.atomic_checkpoints = bool(d.get(RESILIENCE_ATOMIC,
+                                             RESILIENCE_ATOMIC_DEFAULT))
+        self.fsync = bool(d.get(RESILIENCE_FSYNC, RESILIENCE_FSYNC_DEFAULT))
+        self.keep_checkpoint_tags = int(d.get(RESILIENCE_KEEP_TAGS,
+                                              RESILIENCE_KEEP_TAGS_DEFAULT))
+        self.verify_on_load = bool(d.get(RESILIENCE_VERIFY_ON_LOAD,
+                                         RESILIENCE_VERIFY_ON_LOAD_DEFAULT))
+        self.auto_resume = bool(d.get(RESILIENCE_AUTO_RESUME,
+                                      RESILIENCE_AUTO_RESUME_DEFAULT))
+        self.watchdog_enabled = bool(wd.get(WATCHDOG_ENABLED,
+                                            WATCHDOG_ENABLED_DEFAULT))
+        self.watchdog_max_skipped_steps = int(
+            wd.get(WATCHDOG_MAX_SKIPPED, WATCHDOG_MAX_SKIPPED_DEFAULT))
+        self.watchdog_max_nan_losses = int(
+            wd.get(WATCHDOG_MAX_NAN, WATCHDOG_MAX_NAN_DEFAULT))
+        self.watchdog_stall_timeout = float(
+            wd.get(WATCHDOG_STALL_TIMEOUT, WATCHDOG_STALL_TIMEOUT_DEFAULT))
+        self.watchdog_action = wd.get(WATCHDOG_ACTION,
+                                      WATCHDOG_ACTION_DEFAULT)
+        if self.watchdog_action not in ("abort", "continue"):
+            raise ValueError(
+                f'resilience.watchdog.{WATCHDOG_ACTION} must be "abort" or '
+                f'"continue", got {self.watchdog_action!r}')
+        self.watchdog_emergency_dir = wd.get(WATCHDOG_EMERGENCY_DIR,
+                                             WATCHDOG_EMERGENCY_DIR_DEFAULT)
+
+
+def get_resilience_config(param_dict):
+    return DeepSpeedResilienceConfig(param_dict)
+
+
 def get_pipeline_config(param_dict):
     d = param_dict.get(PIPELINE, {})
     return {
@@ -393,6 +435,7 @@ class DeepSpeedConfig:
 
         self.mesh_shape = get_mesh_shape(param_dict)
         self.pipeline = get_pipeline_config(param_dict)
+        self.resilience = get_resilience_config(param_dict)
 
     def _batch_assertion(self):
         train_batch = self.train_batch_size

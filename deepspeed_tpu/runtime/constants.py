@@ -174,6 +174,35 @@ CHECKPOINT_TAG_VALIDATION = "tag_validation"
 CHECKPOINT_TAG_VALIDATION_DEFAULT = "Warn"
 CHECKPOINT_TAG_VALIDATION_MODES = ["WARN", "IGNORE", "FAIL"]
 
+#############################################
+# Resilience (TPU extension): atomic checkpoints, auto-resume, watchdog
+#############################################
+RESILIENCE = "resilience"
+RESILIENCE_ATOMIC = "atomic_checkpoints"        # temp-dir + manifest + rename
+RESILIENCE_ATOMIC_DEFAULT = True
+RESILIENCE_FSYNC = "fsync"                      # fsync payload + dirs on commit
+RESILIENCE_FSYNC_DEFAULT = True
+RESILIENCE_KEEP_TAGS = "keep_checkpoint_tags"   # retention; 0 = keep all
+RESILIENCE_KEEP_TAGS_DEFAULT = 0
+RESILIENCE_VERIFY_ON_LOAD = "verify_on_load"    # manifest replay before load
+RESILIENCE_VERIFY_ON_LOAD_DEFAULT = True
+RESILIENCE_AUTO_RESUME = "auto_resume"          # default for load_checkpoint
+RESILIENCE_AUTO_RESUME_DEFAULT = False
+
+RESILIENCE_WATCHDOG = "watchdog"
+WATCHDOG_ENABLED = "enabled"
+WATCHDOG_ENABLED_DEFAULT = False
+WATCHDOG_MAX_SKIPPED = "max_skipped_steps"      # overflow streak; 0 = off
+WATCHDOG_MAX_SKIPPED_DEFAULT = 0
+WATCHDOG_MAX_NAN = "max_nan_losses"             # NaN/Inf loss streak; 0 = off
+WATCHDOG_MAX_NAN_DEFAULT = 0
+WATCHDOG_STALL_TIMEOUT = "stall_timeout_seconds"  # wall-clock; 0 = off
+WATCHDOG_STALL_TIMEOUT_DEFAULT = 0
+WATCHDOG_ACTION = "action"                      # "abort" | "continue"
+WATCHDOG_ACTION_DEFAULT = "abort"
+WATCHDOG_EMERGENCY_DIR = "emergency_checkpoint_dir"  # None = last save_dir
+WATCHDOG_EMERGENCY_DIR_DEFAULT = None
+
 PIPELINE = "pipeline"               # pipeline engine knobs
 PIPELINE_STAGES = "stages"
 PIPELINE_STAGES_DEFAULT = 1

@@ -39,8 +39,11 @@ from deepspeed_tpu.runtime.fp16.loss_scaler import (LossScaleState,
                                                     update_loss_scale)
 from deepspeed_tpu.runtime.lr_schedules import SCHEDULER_REGISTRY
 from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deepspeed_tpu.utils.jax_compat import ensure_compat
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+
+ensure_compat()
 
 MEMORY_OPT_ALLREDUCE_SIZE = 500000000
 
@@ -143,6 +146,22 @@ class DeepSpeedEngine:
         if self.pld_enabled():
             self.progressive_layer_drop = ProgressiveLayerDrop(
                 theta=self.pld_theta(), gamma=self.pld_gamma())
+
+        # --- resilience ---------------------------------------------------
+        res = self._config.resilience
+        self._resilience = res
+        self._consecutive_skips = 0
+        self._last_ckpt_dir = None
+        self._watchdog = None
+        if res.watchdog_enabled:
+            from deepspeed_tpu.runtime.resilience.watchdog import \
+                TrainingWatchdog
+
+            self._watchdog = TrainingWatchdog(
+                max_skipped_steps=res.watchdog_max_skipped_steps,
+                max_nan_losses=res.watchdog_max_nan_losses,
+                stall_timeout=res.watchdog_stall_timeout,
+                default_action=res.watchdog_action)
 
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
@@ -1555,6 +1574,7 @@ class DeepSpeedEngine:
         assert self._pending_state is None, \
             "step() called between forward() and backward()"
         if self.is_gradient_accumulation_boundary():
+            self._chaos_poison_accum()
             self._take_model_step()
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).stop()
@@ -1647,6 +1667,8 @@ class DeepSpeedEngine:
         self._last_metrics = {"overflow": not finite,
                               "grad_norm": getattr(self, "_last_grad_norm", 0.0),
                               "loss_scale": scale}
+        self._observe_step_outcome(loss=self._pending_loss,
+                                   overflow=not finite)
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps)
 
@@ -1666,16 +1688,22 @@ class DeepSpeedEngine:
             self.progressive_layer_drop.update_state(self.global_steps)
         self._last_metrics = metrics
         self._last_grad_norm = metrics["grad_norm"]
+        overflow = None
         if self.fp16_enabled():
             # overflow must be visible when it happens (reference
             # fused_optimizer.py logs every skipped step); one small scalar
             # fetch on the already-host-driven non-fused path
-            if bool(jax.device_get(metrics["overflow"])):
+            overflow = bool(jax.device_get(metrics["overflow"]))
+            if overflow:
                 log_dist(
                     f"OVERFLOW! Skipping step {self.global_steps}; "
                     f"reducing loss scale to "
                     f"{float(jax.device_get(new_state.scaler.loss_scale)):g}",
                     ranks=[0])
+        elif self._watchdog is not None:
+            overflow = bool(jax.device_get(metrics["overflow"]))
+        self._observe_step_outcome(loss=self._pending_loss,
+                                   overflow=overflow)
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps)
             self._write_monitor({"lr": lr,
@@ -1727,14 +1755,17 @@ class DeepSpeedEngine:
             if prev_fetch is not None:
                 self._consume_grad_fetch(prev_fetch)
             self.micro_steps += gas
+            self._pending_loss = jnp.mean(jnp.stack(losses))
+            self._chaos_poison_accum()
             self._take_model_step_offload()  # reports progress itself
             self.tput_timer.stop()
             # mean over micro-batches, matching the fused path's metric
-            return jnp.mean(jnp.stack(losses))
+            return self._pending_loss
         dev = self._shard_stacked_batch(batch)
         self._maybe_profile(self._shard_batch(_first_micro(batch)))
         lr = self._advance_lr()
 
+        self._chaos_poison_accum()
         self.tput_timer.start()
         with jax.set_mesh(self.mesh):
             new_state, metrics = self._fused_callable()(
@@ -1747,6 +1778,14 @@ class DeepSpeedEngine:
         self._last_metrics = metrics
         self._last_grad_norm = metrics["grad_norm"]
         self.tput_timer.stop()
+        # the fused path never syncs host-side; the overflow scalar is only
+        # fetched when a watchdog is armed (one small device_get per step)
+        overflow = None
+        if self._watchdog is not None:
+            overflow = bool(jax.device_get(metrics["overflow"]))
+        self._observe_step_outcome(
+            loss=metrics["loss"] if self._watchdog is not None else None,
+            overflow=overflow)
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps)
         return metrics["loss"]
@@ -1764,7 +1803,12 @@ class DeepSpeedEngine:
 
             self._jit_eval = jax.jit(ev)
         with jax.set_mesh(self.mesh):
-            return self._jit_eval(self.state, self._shard_batch(batch))
+            loss = self._jit_eval(self.state, self._shard_batch(batch))
+        if self._watchdog is not None:
+            # a long validation loop between optimizer steps is progress,
+            # not a stalled step
+            self._watchdog.heartbeat()
+        return loss
 
     def _shard_stacked_batch(self, batch):
         """Batch with leading (gas, batch...) dims: shard dim1 over data."""
@@ -1783,6 +1827,103 @@ class DeepSpeedEngine:
             return jax.device_put(x, sh)
 
         return jax.tree_util.tree_map(put, batch)
+
+    @property
+    def watchdog(self):
+        """The TrainingWatchdog (None unless resilience.watchdog.enabled);
+        register callbacks via engine.watchdog.add_callback(cb)."""
+        return self._watchdog
+
+    def consecutive_skipped_steps(self):
+        """Current run of overflow-skipped optimizer steps (resets to 0 on
+        any successful step).  Tracked on every host-synced step path, and
+        on the fused device path whenever the watchdog is enabled."""
+        return self._consecutive_skips
+
+    def _observe_step_outcome(self, loss=None, overflow=None):
+        """Shared post-step resilience bookkeeping for every step path:
+        maintains the consecutive-skip streak, mirrors recovery progress
+        (scale + streak) into _last_metrics, and feeds the watchdog.  On an
+        abort verdict an emergency checkpoint is written before the
+        WatchdogAlarm propagates."""
+        if overflow is not None:
+            self._consecutive_skips = \
+                self._consecutive_skips + 1 if overflow else 0
+            # published only when actually observed: the fused train_batch
+            # path skips the overflow fetch without a watchdog (stays
+            # host-async), and a frozen 0 would read as "no skips ever"
+            if isinstance(self._last_metrics, dict):
+                metrics = dict(self._last_metrics)
+                metrics["consecutive_skips"] = self._consecutive_skips
+                self._last_metrics = metrics
+        if self._watchdog is None:
+            return
+        from deepspeed_tpu.runtime.resilience.watchdog import WatchdogAlarm
+
+        try:
+            self._watchdog.observe_step(self.global_steps, loss=loss,
+                                        overflow=bool(overflow))
+        except WatchdogAlarm as alarm:
+            self._emergency_checkpoint(alarm.event)
+            raise
+
+    def _emergency_checkpoint(self, event=None):
+        """Final checkpoint before a watchdog abort tears the run down."""
+        import jax
+
+        from deepspeed_tpu.runtime.resilience.watchdog import EVENT_STALL
+
+        if event is not None and event.kind == EVENT_STALL \
+                and jax.process_count() > 1:
+            # stall detection is host-local wall clock: peers may not have
+            # fired, and the collective save below would deadlock against
+            # their training-step collectives.  Overflow/NaN streaks derive
+            # from globally-reduced values, so every host aborts together.
+            logger.warning(
+                "watchdog abort (stall): skipping emergency checkpoint on a "
+                "multi-process run — stall verdicts are host-local and the "
+                "collective save would hang peers")
+            return
+        save_dir = self._resilience.watchdog_emergency_dir \
+            or self._last_ckpt_dir
+        if not save_dir:
+            logger.warning(
+                "watchdog abort: skipping emergency checkpoint (no prior "
+                "save_checkpoint dir and no resilience.watchdog."
+                "emergency_checkpoint_dir configured)")
+            return
+        try:
+            # save_latest=False + the manifest flag: the aborting state may
+            # itself be the problem (NaN params on a non-fp16 divergence),
+            # so restarts must prefer the last healthy checkpoint — the
+            # emergency tag is kept for postmortem and as a last resort
+            self.save_checkpoint(save_dir,
+                                 tag=f"emergency_step{self.global_steps}",
+                                 save_latest=False,
+                                 manifest_meta={"emergency": True})
+        except Exception as e:
+            # best-effort by definition: whatever the save raises, the
+            # caller must still see the WatchdogAlarm, not a ckpt error
+            logger.error(f"emergency checkpoint failed: "
+                         f"{type(e).__name__}: {e}")
+
+    def _chaos_poison_accum(self):
+        """Test hook: replace the grad accumulator with NaN when a chaos
+        nan_grads plan is armed (no-op in production)."""
+        from deepspeed_tpu.runtime.resilience import chaos
+
+        if chaos.active() is None or not chaos.consume_nan_grad_step():
+            return
+        if self._offload and getattr(self, "_host_grad_accum", None):
+            for acc in self._host_grad_accum:
+                acc.fill(np.nan)
+            return
+        import jax
+        import jax.numpy as jnp
+
+        poisoned = jax.tree_util.tree_map(
+            lambda a: jnp.full_like(a, jnp.nan), self.state.accum)
+        self.state = self.state._replace(accum=poisoned)
 
     def _report_progress(self, step):
         lr = self._current_lr()
@@ -1826,21 +1967,15 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:1279-1597; layout kept similar)
     # ------------------------------------------------------------------
-    def save_checkpoint(self, save_dir, tag=None, client_state=None,
-                        save_latest=True, backend=None):
-        """backend: None/'auto' (orbax when multi-process — sharded write
-        without gathering, the fix for replicate-on-save OOM), 'npz'
-        (single-file), or 'orbax' (sharded; supports world-size-elastic
-        restore via orbax's sharding-aware load)."""
+    def _write_checkpoint_files(self, path, client_state, backend):
+        """Write every payload file of one checkpoint tag into ``path``
+        (the temp dir on the atomic path).  Returns the backend used.
+        Each file write is followed by a chaos hook so fault-injection
+        tests can kill/corrupt the write at any point."""
         import jax
 
-        assert self.state is not None, "nothing to save; train state not built"
-        client_state = client_state or {}
-        if tag is None:
-            tag = f"global_step{self.global_steps}"
-        self._checkpoint_tag_validation(tag)
-        path = os.path.join(save_dir, str(tag))
-        os.makedirs(path, exist_ok=True)
+        from deepspeed_tpu.runtime.resilience import chaos
+
         if backend in (None, "auto"):
             # orbax by default: sharded write with NO host gather — npz
             # would materialize the full TrainState on process 0 (a 10B
@@ -1860,6 +1995,7 @@ class DeepSpeedEngine:
             ckptr.save(os.path.join(os.path.abspath(path), "orbax_state"),
                        self.state)
             ckptr.wait_until_finished()
+            chaos.file_written(os.path.join(path, "orbax_state"))
         num_leaves = len(jax.tree_util.tree_leaves(self.state))
         if backend == "npz" and jax.process_index() == 0:
             from deepspeed_tpu.runtime.checkpoint_utils import \
@@ -1867,8 +2003,9 @@ class DeepSpeedEngine:
 
             host_state = jax.device_get(self.state)
             flat, _ = jax.tree_util.tree_flatten(host_state)
-            np.savez(os.path.join(path, "model_states.npz"),
-                     **leaves_to_npz_dict(flat))
+            fname = os.path.join(path, "model_states.npz")
+            self._ckpt_savez(fname, **leaves_to_npz_dict(flat))
+            chaos.file_written(fname)
         off_leaves = None
         if self._offload:
             # shard-local stepping means each process's host arrays are only
@@ -1883,9 +2020,10 @@ class DeepSpeedEngine:
                 from deepspeed_tpu.runtime.checkpoint_utils import \
                     leaves_to_npz_dict
 
-                np.savez(os.path.join(path, "offload_states.npz"),
-                         **leaves_to_npz_dict(off_leaves),
-                         opt_step=self._host_opt["step"])
+                fname = os.path.join(path, "offload_states.npz")
+                self._ckpt_savez(fname, **leaves_to_npz_dict(off_leaves),
+                                 opt_step=self._host_opt["step"])
+                chaos.file_written(fname)
             meta = {
                 "global_steps": self.global_steps,
                 "micro_steps": self.micro_steps,
@@ -1897,29 +2035,367 @@ class DeepSpeedEngine:
                 "client_state": client_state,
                 "num_leaves": num_leaves,
             }
-            with open(os.path.join(path, "metadata.pkl"), "wb") as f:
+            fname = os.path.join(path, "metadata.pkl")
+            with open(fname, "wb") as f:
                 pickle.dump(meta, f)
-            if save_latest:
-                with open(os.path.join(save_dir, "latest"), "w") as f:
-                    f.write(str(tag))
-        log_dist(f"Saved checkpoint {path} (backend={backend})", ranks=[0])
+            chaos.file_written(fname)
+        return backend
+
+    def _assert_saveable(self):
+        assert self.state is not None, \
+            "nothing to save; train state not built"
+
+    def _assert_loadable(self):
+        assert self.state is not None, \
+            "call forward/train_batch once (or init_from_batch) before " \
+            "load_checkpoint"
+
+    def _ckpt_savez(self, fname, **arrays):
+        """np.savez for checkpoint payloads.  On the atomic path the bytes
+        are sha256'd concurrently with the write so the manifest pass does
+        not have to re-read and re-hash the file."""
+        if self._resilience.atomic_checkpoints:
+            from deepspeed_tpu.runtime.resilience.atomic import savez_hashed
+
+            savez_hashed(fname, **arrays)
+        else:
+            np.savez(fname, **arrays)
+
+    def _checkpoint_manifest_meta(self, tag):
+        """World/step metadata recorded in the tag manifest (human- and
+        tooling-readable without unpickling the payload).  The "backend"
+        key is filled in by save_checkpoint once the payload write has
+        resolved it."""
+        return {
+            "tag": str(tag),
+            "global_steps": self.global_steps,
+            "micro_steps": self.micro_steps,
+            "world": {
+                "dp": self.dp_world_size,
+                "mp": self.mp_world_size,
+                "sp": self.sp_world_size,
+            },
+        }
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True, backend=None, manifest_meta=None):
+        """backend: None/'auto' (orbax when multi-process — sharded write
+        without gathering, the fix for replicate-on-save OOM), 'npz'
+        (single-file), or 'orbax' (sharded; supports world-size-elastic
+        restore via orbax's sharding-aware load).  manifest_meta: extra
+        keys merged into the tag manifest (atomic path only).
+
+        With resilience.atomic_checkpoints (default on) the tag is written
+        into a temp dir with a checksum manifest, fsync'd, atomically
+        renamed into place, and only then is the ``latest`` pointer
+        updated — a crash at any point leaves the previous checkpoint
+        intact and loadable."""
+        import jax
+
+        self._assert_saveable()
+        client_state = client_state or {}
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        self._checkpoint_tag_validation(tag)
+        res = self._resilience
+        self._last_ckpt_dir = save_dir
+
+        if not res.atomic_checkpoints:
+            # legacy in-place layout (crash window: torn tag, stale latest)
+            path = os.path.join(save_dir, str(tag))
+            os.makedirs(path, exist_ok=True)
+            backend = self._write_checkpoint_files(path, client_state,
+                                                   backend)
+            if save_latest and jax.process_index() == 0:
+                from deepspeed_tpu.runtime.resilience.atomic import \
+                    write_latest
+
+                write_latest(save_dir, tag, fsync=False)
+            if jax.process_index() == 0 and res.keep_checkpoint_tags > 0:
+                from deepspeed_tpu.runtime.resilience.atomic import gc_tags
+
+                gc_tags(save_dir, res.keep_checkpoint_tags,
+                        protect={str(tag)})
+            log_dist(f"Saved checkpoint {path} (backend={backend}, "
+                     f"non-atomic)", ranks=[0])
+            if self._watchdog is not None:
+                self._watchdog.heartbeat()
+            return True
+
+        from deepspeed_tpu.runtime.resilience.atomic import atomic_tag, \
+            gc_tags
+
+        meta = self._checkpoint_manifest_meta(tag)
+        meta.update(manifest_meta or {})
+        commit = atomic_tag(save_dir, tag, meta=meta,
+                            update_latest=save_latest, fsync=res.fsync)
+        if jax.process_count() > 1:
+            # every process writes its shards into the same temp dir on the
+            # shared FS; process 0 commits (manifest + rename) after a
+            # barrier so no shard write races the rename.  Every phase
+            # follows the coordination.all_agree discipline: swallow the
+            # local error, agree on success flags, only then proceed or
+            # raise — so no rank can leave peers wedged in a collective.
+            from deepspeed_tpu.runtime.resilience.coordination import \
+                all_agree
+
+            def _agree(err, phase):
+                agreed, n_failed = all_agree(err is None)
+                if agreed:
+                    return
+                if err is not None:
+                    raise err
+                raise RuntimeError(
+                    f"checkpoint {phase} for tag {tag!r} failed on "
+                    f"{n_failed} peer process(es); "
+                    f"tag aborted, previous checkpoint left intact")
+
+            # process 0 alone creates the temp dir (its __enter__ rmtree's
+            # any stale .tmp- from a prior crash); peers wait for the
+            # agreement so that cleanup can never delete shards a peer has
+            # already started writing
+            enter_err = None
+            if jax.process_index() == 0:
+                try:
+                    commit.__enter__()
+                except BaseException as e:
+                    enter_err = e
+            _agree(enter_err, "temp-dir setup")
+            write_err = None
+            try:
+                # peer makedirs sits INSIDE the agreed phase: a rank-local
+                # mkdir failure must feed the agreement, not raise past it
+                if jax.process_index() != 0:
+                    os.makedirs(commit.tmp, exist_ok=True)
+                backend = self._write_checkpoint_files(commit.tmp,
+                                                       client_state, backend)
+            except BaseException as e:
+                write_err = e
+            try:
+                # the agreement doubles as the payload barrier: no shard
+                # write can race the commit below
+                _agree(write_err, "write")
+            except BaseException as e:
+                if jax.process_index() == 0:
+                    commit.__exit__(type(e), e, e.__traceback__)
+                raise
+            commit_err = None
+            if jax.process_index() == 0:
+                try:
+                    commit.meta["backend"] = backend
+                    commit.__exit__(None, None, None)
+                except BaseException as e:
+                    commit_err = e
+            _agree(commit_err, "commit")
+        else:
+            with commit as tmp:
+                backend = self._write_checkpoint_files(tmp, client_state,
+                                                       backend)
+                commit.meta["backend"] = backend
+        if jax.process_index() == 0 and res.keep_checkpoint_tags > 0:
+            gc_tags(save_dir, res.keep_checkpoint_tags, protect={str(tag)})
+        log_dist(f"Saved checkpoint {os.path.join(save_dir, str(tag))} "
+                 f"(backend={backend}, atomic)", ranks=[0])
+        if self._watchdog is not None:
+            # a large fsync'd save legitimately takes minutes; don't let
+            # the stall detector read it as a hung step
+            self._watchdog.heartbeat()
         return True
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
-                        load_optimizer_states=True, load_lr_scheduler_states=True):
+                        load_optimizer_states=True,
+                        load_lr_scheduler_states=True, auto_resume=None):
+        """Restore from ``load_dir``.
+
+        tag=None loads the ``latest``-pointed tag.  With
+        ``auto_resume=True`` (or resilience.auto_resume in ds_config) and
+        ``tag=None``, the directory is scanned newest-first and
+        corrupt/partial tags — failed manifest verification OR a
+        load-time error — are skipped transparently until the newest
+        intact checkpoint loads; returns (None, {}) when nothing intact
+        exists.  An explicitly named tag is never second-guessed: it
+        loads, or raises CheckpointCorrupt (never loads bad bytes
+        silently, never substitutes a different tag)."""
+        from deepspeed_tpu.runtime.resilience import atomic as atomic_lib
+        from deepspeed_tpu.runtime.resilience.atomic import CheckpointCorrupt
+
+        res = self._resilience
+        # a resumed run that aborts before its first save still has a
+        # checkpoint home: the watchdog's emergency fallback dir
+        self._last_ckpt_dir = self._last_ckpt_dir or load_dir
+        if tag is not None:
+            # an explicitly named tag is never second-guessed: it loads or
+            # it raises; the newest-first scan is for tag=None only
+            auto_resume = False
+        elif auto_resume is None:
+            auto_resume = res.auto_resume
+        if auto_resume:
+            return self._auto_resume_load(load_dir, load_module_strict,
+                                          load_optimizer_states,
+                                          load_lr_scheduler_states)
+
+        if tag is None:
+            tag = atomic_lib.read_latest(load_dir)
+            if tag is None:
+                logger.warning(f"No 'latest' file at {load_dir}; nothing loaded")
+                return None, {}
+        if res.verify_on_load:
+            import jax
+
+            # leader-only verify + agreed verdict: N hosts re-hashing the
+            # same multi-GB manifest multiplies load I/O by N, and a
+            # rank-local verify failure must fail EVERY rank together —
+            # one rank raising while peers enter the collective restore
+            # would wedge the job (same discipline as save/auto-resume)
+            from deepspeed_tpu.runtime.resilience.coordination import \
+                all_agree
+
+            if jax.process_index() == 0:
+                ok, reason = atomic_lib.verify_tag(os.path.join(load_dir,
+                                                                str(tag)))
+            else:
+                ok, reason = True, "verification failed on process 0"
+            ok, _ = all_agree(ok)
+            if not ok:
+                raise CheckpointCorrupt(
+                    f"checkpoint tag {tag!r} under {load_dir} failed "
+                    f"verification: {reason}. Pass auto_resume=True to fall "
+                    f"back to the newest intact checkpoint.")
+        return self._load_checkpoint_tag(load_dir, tag, load_module_strict,
+                                         load_optimizer_states,
+                                         load_lr_scheduler_states)
+
+    def _auto_resume_load(self, load_dir, load_module_strict,
+                          load_optimizer_states, load_lr_scheduler_states):
+        """Newest-first scan that falls back past corrupt/unloadable tags.
+
+        Multi-process: process 0 alone selects each candidate (so every
+        host attempts the SAME tag — per-host selection could send hosts
+        into collective restores on different directories, a deadlock)
+        and broadcasts it; after each attempt all hosts agree on success
+        before returning, falling back together otherwise.  A failed
+        attempt rolls the engine back to its pre-attempt state."""
+        import jax
+
+        from deepspeed_tpu.runtime.resilience import atomic as atomic_lib
+
+        from deepspeed_tpu.runtime.resilience.coordination import \
+            TAG_BCAST_BYTES, all_agree, broadcast_tag
+
+        res = self._resilience
+        multi = jax.process_count() > 1
+        leader = jax.process_index() == 0
+        cands = iter(atomic_lib.resume_candidates(load_dir)) \
+            if (leader or not multi) else iter(())
+        last_err = None
+        while True:
+            cand = None
+            for c in cands:  # leader-side: next candidate passing verify
+                if multi and len(str(c).encode()) > TAG_BCAST_BYTES:
+                    logger.warning(f"auto-resume: skipping tag {c!r} "
+                                   f"(name exceeds the {TAG_BCAST_BYTES}-"
+                                   f"byte broadcast buffer)")
+                    continue
+                ok, reason = atomic_lib.verify_tag(
+                    os.path.join(load_dir, c),
+                    check_checksums=res.verify_on_load)
+                if ok:
+                    cand = c
+                    break
+                logger.warning(f"auto-resume: skipping tag {c!r} ({reason})")
+            if multi:
+                cand = broadcast_tag(cand)
+            if cand is None:
+                break
+            # errors that cannot be tag-specific must fail loudly, not be
+            # caught below as "corrupt tag" — the blanket catch would
+            # reject every intact checkpoint and silently 'start fresh'
+            # (state-built status is identical on every rank, so this
+            # raises everywhere together)
+            self._assert_loadable()
+            snap = self._ckpt_state_snapshot()
+            # any Exception means "this tag is bad" — the narrow whitelist
+            # would let an unforeseen error (orbax XlaRuntimeError, tree
+            # mismatch TypeError) escape without the rollback below, and on
+            # multi-host without the agreement, wedging peers in the
+            # collective (same discipline as the save path)
+            err = None
+            try:
+                result = self._load_checkpoint_tag(
+                    load_dir, cand, load_module_strict,
+                    load_optimizer_states, load_lr_scheduler_states)
+            except Exception as e:
+                err = e
+            ok, _ = all_agree(err is None)
+            if ok:
+                return result
+            # roll back everything _load_checkpoint_tag may have half-set:
+            # "starting fresh" must not mean "corrupt params, stale opt"
+            self._ckpt_state_restore(snap)
+            if err is not None:
+                last_err = err
+                logger.warning(f"auto-resume: tag {cand!r} failed to load "
+                               f"({type(err).__name__}: {err}); falling "
+                               f"back to an older checkpoint")
+            else:
+                last_err = last_err or RuntimeError("peer load failure")
+                logger.warning(f"auto-resume: a peer process failed to "
+                               f"load tag {cand!r}; falling back together")
+        if last_err is not None:
+            logger.warning(f"auto-resume: no loadable checkpoint under "
+                           f"{load_dir}; starting fresh")
+        else:
+            logger.warning(f"auto-resume: no checkpoint under "
+                           f"{load_dir}; starting fresh")
+        return None, {}
+
+    def _ckpt_state_snapshot(self):
+        """References/copies of everything _load_checkpoint_tag mutates
+        (device state is immutable, so references suffice; host-side
+        mutables are copied)."""
+        import copy
+
+        return {
+            "state": self.state,
+            "global_steps": self.global_steps,
+            "micro_steps": self.micro_steps,
+            "onebit_latch": getattr(self, "_onebit_frozen_latch", False),
+            "host_master": getattr(self, "_host_master_flat", None),
+            "host_opt": dict(self._host_opt)
+            if getattr(self, "_host_opt", None) is not None else None,
+            "host_skipped": getattr(self, "_host_skipped", None),
+            "host_scale": self._host_scaler.cur_scale
+            if getattr(self, "_host_scaler", None) is not None else None,
+            "lr_sched": copy.deepcopy(self.lr_scheduler.state_dict())
+            if self.lr_scheduler is not None else None,
+        }
+
+    def _ckpt_state_restore(self, snap):
+        self.state = snap["state"]
+        self.global_steps = snap["global_steps"]
+        self.micro_steps = snap["micro_steps"]
+        self._onebit_frozen_latch = snap["onebit_latch"]
+        if snap["host_master"] is not None:
+            self._host_master_flat = snap["host_master"]
+        if snap["host_opt"] is not None:
+            self._host_opt.clear()
+            self._host_opt.update(snap["host_opt"])
+        if snap["host_skipped"] is not None:
+            self._host_skipped = snap["host_skipped"]
+        if snap["host_scale"] is not None:
+            self._host_scaler.cur_scale = snap["host_scale"]
+        if snap["lr_sched"] is not None and self.lr_scheduler is not None:
+            self.lr_scheduler.load_state_dict(snap["lr_sched"])
+
+    def _load_checkpoint_tag(self, load_dir, tag, load_module_strict=True,
+                             load_optimizer_states=True,
+                             load_lr_scheduler_states=True):
         import jax
 
         # imported here (not in the npz branch) because the offload restore
         # below needs it regardless of which backend saved the model state
         from deepspeed_tpu.runtime.checkpoint_utils import npz_dict_to_leaves
 
-        if tag is None:
-            latest = os.path.join(load_dir, "latest")
-            if not os.path.exists(latest):
-                logger.warning(f"No 'latest' file at {load_dir}; nothing loaded")
-                return None, {}
-            with open(latest) as f:
-                tag = f.read().strip()
         path = os.path.join(load_dir, str(tag))
         with open(os.path.join(path, "metadata.pkl"), "rb") as f:
             meta = pickle.load(f)
@@ -1998,6 +2474,9 @@ class DeepSpeedEngine:
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         log_dist(f"Loaded checkpoint {path} (saved at dp={meta['dp_world_size']}, "
                  f"now dp={self.dp_world_size})", ranks=[0])
+        if self._watchdog is not None:
+            # mid-run restores can take minutes; not a stalled step
+            self._watchdog.heartbeat()
         return path, meta.get("client_state", {})
 
     def init_from_batch(self, batch):

@@ -440,6 +440,7 @@ class PipelineEngine(DeepSpeedEngine):
         self.tput_timer.start()
 
         losses, mid_auxes = self._exec_train_schedule(micros)
+        self._chaos_poison_accum()
 
         # --- optimizer step (host-coordinated across stages) -----------
         lr = self._advance_lr()
@@ -500,6 +501,11 @@ class PipelineEngine(DeepSpeedEngine):
                     loss += float(jax.device_get(
                         self._stage_jits[s]["mean_scalar"](auxes)))
         self._last_loss = loss
+        self._last_metrics = {
+            "overflow": not all_finite,
+            "grad_norm": getattr(self, "_last_grad_norm", 0.0),
+            "loss_scale": scale, "loss": loss}
+        self._observe_step_outcome(loss=loss, overflow=not all_finite)
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps)
         return loss
@@ -527,7 +533,11 @@ class PipelineEngine(DeepSpeedEngine):
                     else:
                         x = jits["eval_fwd"](self.stage_states[s].params, x, rng)
                         x = self._transfer(x, s + 1)
-        return float(np.mean([float(jax.device_get(l)) for l in losses]))
+        out = float(np.mean([float(jax.device_get(l)) for l in losses]))
+        if self._watchdog is not None:
+            # eval between optimizer steps is progress, not a stalled step
+            self._watchdog.heartbeat()
+        return out
 
     def _collect_micros(self, data_iter, batch):
         gas = self.micro_batches
@@ -703,22 +713,45 @@ class PipelineEngine(DeepSpeedEngine):
         return {"params": st.params, "master": st.master,
                 "opt_state": st.opt_state}
 
-    def save_checkpoint(self, save_dir, tag=None, client_state=None,
-                        save_latest=True):
+    def _chaos_poison_accum(self):
+        """Pipeline variant of the chaos NaN-grad hook: the accumulator
+        lives per stage, not on a single TrainState."""
+        from deepspeed_tpu.runtime.resilience import chaos
+
+        if chaos.active() is None or not chaos.consume_nan_grad_step():
+            return
+        import jax
+        import jax.numpy as jnp
+
+        for s in range(self.num_stages):
+            with jax.set_mesh(self._submeshes[s]):
+                st = self.stage_states[s]
+                poisoned = jax.tree_util.tree_map(
+                    lambda a: jnp.full_like(a, jnp.nan), st.accum)
+                self.stage_states[s] = st._replace(accum=poisoned)
+
+    def _assert_saveable(self):
+        assert self.stage_states is not None, "no pipeline state to save"
+
+    def _assert_loadable(self):
+        assert self.stage_states is not None, \
+            "run one batch (or _ensure_pipe_state) before load_checkpoint"
+
+    def _write_checkpoint_files(self, path, client_state, backend):
+        """Pipeline payload: layer-granular layout — one file per layer
+        param key, entries keyed by the leaf's tree path (identical no
+        matter which stage owns the layer), plus a 'globals' file for
+        layer-independent optimizer scalars (identical on every stage).
+        Runs inside the parent's atomic commit path: ``path`` is the tag
+        temp dir and each write feeds the chaos fault-injection hooks."""
+        if backend not in (None, "auto", "npz", "npz-layer"):
+            raise ValueError(
+                f"pipeline checkpoints only support the layer-granular npz "
+                f"backend; got backend={backend!r}")
         import jax
 
-        assert self.stage_states is not None, "no pipeline state to save"
-        client_state = client_state or {}
-        if tag is None:
-            tag = f"global_step{self.global_steps}"
-        path = os.path.join(save_dir, str(tag))
-        os.makedirs(path, exist_ok=True)
-
-        # layer-granular layout: one file per layer param key, entries keyed
-        # by the leaf's tree path (identical no matter which stage owns the
-        # layer), plus a 'globals' file for layer-independent optimizer
-        # scalars (identical on every stage)
         from deepspeed_tpu.runtime.checkpoint_utils import named_leaf_entry
+        from deepspeed_tpu.runtime.resilience import chaos
 
         layer_keys = self._layer_key_set()
         per_layer = {}
@@ -733,8 +766,12 @@ class PipelineEngine(DeepSpeedEngine):
                 else:
                     per_layer.setdefault(k, {}).update(entry)
         for k, entries in per_layer.items():
-            np.savez(os.path.join(path, f"{k}-states.npz"), **entries)
-        np.savez(os.path.join(path, "globals-states.npz"), **global_leaves)
+            fname = os.path.join(path, f"{k}-states.npz")
+            self._ckpt_savez(fname, **entries)
+            chaos.file_written(fname)
+        fname = os.path.join(path, "globals-states.npz")
+        self._ckpt_savez(fname, **global_leaves)
+        chaos.file_written(fname)
         meta = {
             "global_steps": self.global_steps,
             "micro_steps": self.micro_steps,
@@ -749,27 +786,34 @@ class PipelineEngine(DeepSpeedEngine):
             if self.lr_scheduler is not None else None,
             "client_state": client_state,
         }
-        with open(os.path.join(path, "metadata.pkl"), "wb") as f:
+        fname = os.path.join(path, "metadata.pkl")
+        with open(fname, "wb") as f:
             pickle.dump(meta, f)
-        if save_latest:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(str(tag))
-        log_dist(f"Saved pipeline checkpoint {path} "
+        chaos.file_written(fname)
+        log_dist(f"Wrote pipeline checkpoint payload "
                  f"({len(per_layer)} layer files)", ranks=[0])
-        return True
+        return "npz-layer"
 
-    def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
-                        load_optimizer_states=True,
-                        load_lr_scheduler_states=True):
+    def _ckpt_state_snapshot(self):
+        snap = super()._ckpt_state_snapshot()
+        snap["stage_states"] = list(self.stage_states) \
+            if self.stage_states is not None else None
+        snap["pipe_scaler"] = dict(self._pipe_scaler.__dict__) \
+            if getattr(self, "_pipe_scaler", None) is not None else None
+        return snap
+
+    def _ckpt_state_restore(self, snap):
+        super()._ckpt_state_restore(snap)
+        if snap.get("stage_states") is not None:
+            self.stage_states = snap["stage_states"]
+        if snap.get("pipe_scaler") is not None:
+            self._pipe_scaler.__dict__.update(snap["pipe_scaler"])
+
+    def _load_checkpoint_tag(self, load_dir, tag, load_module_strict=True,
+                             load_optimizer_states=True,
+                             load_lr_scheduler_states=True):
         import jax
 
-        if tag is None:
-            latest = os.path.join(load_dir, "latest")
-            if not os.path.exists(latest):
-                logger.warning(f"No 'latest' file at {load_dir}")
-                return None, {}
-            with open(latest) as f:
-                tag = f.read().strip()
         path = os.path.join(load_dir, str(tag))
         with open(os.path.join(path, "metadata.pkl"), "rb") as f:
             meta = pickle.load(f)

@@ -1,0 +1,36 @@
+"""Resilient-training runtime: atomic checkpoints, auto-resume, watchdog.
+
+Long multi-host runs die for boring reasons — a preempted TPU-VM killed
+mid-`np.savez`, a flaky NFS write, a loss-scale death spiral, a hung
+collective.  This package makes those survivable:
+
+- ``atomic``: write-to-temp + manifest (per-file size/checksum) + fsync +
+  atomic rename, ``latest`` pointer updated last, retention GC.
+- ``watchdog``: consecutive-overflow / NaN-loss / wall-clock-stall
+  detection with callbacks that can abort cleanly or back off.
+- ``chaos``: fault-injection hooks (kill mid-write, corrupt a leaf,
+  poison grads) used by tests/unit/test_resilience.py to prove recovery.
+- ``coordination``: the multi-host agree/broadcast discipline the engine
+  save/load paths share (fail together, never wedge peers in a barrier).
+"""
+from deepspeed_tpu.runtime.resilience.atomic import (MANIFEST_NAME,
+                                                     CheckpointCorrupt,
+                                                     atomic_tag, gc_tags,
+                                                     is_emergency_tag,
+                                                     list_tags, load_manifest,
+                                                     read_latest,
+                                                     resume_candidates,
+                                                     select_resume_tag,
+                                                     verify_tag, write_latest,
+                                                     write_manifest)
+from deepspeed_tpu.runtime.resilience.watchdog import (TrainingWatchdog,
+                                                       WatchdogAlarm,
+                                                       WatchdogEvent)
+
+__all__ = [
+    "MANIFEST_NAME", "CheckpointCorrupt", "atomic_tag", "gc_tags",
+    "is_emergency_tag", "list_tags", "load_manifest", "read_latest",
+    "resume_candidates", "select_resume_tag",
+    "verify_tag", "write_latest", "write_manifest",
+    "TrainingWatchdog", "WatchdogAlarm", "WatchdogEvent",
+]

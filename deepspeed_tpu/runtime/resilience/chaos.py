@@ -1,0 +1,127 @@
+"""Fault-injection hooks for resilience testing.
+
+A ``ChaosPlan`` armed via :func:`arm` lets tests kill a checkpoint write
+mid-flight (after N leaf files, or at a named commit point), corrupt the
+bytes of a just-written file, or poison gradients with NaN for a step
+window — proving end-to-end that the atomic commit path and the watchdog
+actually recover.  All hooks are no-ops when nothing is armed, so the
+production code paths pay one ``is None`` check.
+
+Never arm chaos outside tests.
+"""
+import os
+import threading
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class ChaosInterrupt(RuntimeError):
+    """Simulated preemption: raised from inside a checkpoint write."""
+
+
+class ChaosPlan:
+    """Counters for one armed fault scenario (see :func:`arm`)."""
+
+    def __init__(self, kill_after_files=None, kill_at_point=None,
+                 corrupt_after_files=None, corrupt_nbytes=4,
+                 nan_grad_steps=0):
+        self.kill_after_files = kill_after_files
+        self.kill_at_point = kill_at_point
+        self.corrupt_after_files = corrupt_after_files
+        self.corrupt_nbytes = corrupt_nbytes
+        self.nan_grad_steps = nan_grad_steps
+        self.files_written = 0
+        self.fired = []
+        self._lock = threading.Lock()
+
+
+_plan = None
+
+
+def arm(**kwargs):
+    """Arm a fault scenario.
+
+    kill_after_files=N   raise ChaosInterrupt right after the Nth leaf file
+                         of a checkpoint write lands (1-based).
+    kill_at_point=NAME   raise ChaosInterrupt at a named commit point:
+                         'before_manifest' | 'before_rename' | 'before_latest'.
+    corrupt_after_files=N  flip bytes in the Nth written file (silent disk
+                         corruption; the manifest checksum must catch it).
+    nan_grad_steps=K     poison the gradient accumulator with NaN for the
+                         next K optimizer steps (drives overflow/NaN streaks).
+    """
+    global _plan
+    _plan = ChaosPlan(**kwargs)
+    return _plan
+
+
+def disarm():
+    global _plan
+    _plan = None
+
+
+def active():
+    return _plan
+
+
+def file_written(path):
+    """Called by the atomic writer after each payload lands on disk.
+
+    ``path`` may be a directory (the orbax backend writes a sharded tree);
+    corruption then hits the largest file inside it.
+    """
+    if _plan is None:
+        return
+    with _plan._lock:
+        _plan.files_written += 1
+        n = _plan.files_written
+    if _plan.corrupt_after_files is not None and n == _plan.corrupt_after_files:
+        target = path
+        if os.path.isdir(path):
+            inner = [os.path.join(root, name)
+                     for root, _dirs, names in os.walk(path)
+                     for name in names]
+            target = max(inner, key=os.path.getsize, default=None)
+        if target is not None and os.path.isfile(target):
+            corrupt_file(target, nbytes=_plan.corrupt_nbytes)
+            _plan.fired.append(("corrupt", target))
+        else:
+            logger.warning(f"chaos: corrupt target {path} has no file; "
+                           f"nothing corrupted")
+    if _plan.kill_after_files is not None and n >= _plan.kill_after_files:
+        _plan.fired.append(("kill_after_files", path))
+        raise ChaosInterrupt(
+            f"chaos: killed checkpoint write after {n} files ({path})")
+
+
+def point(name):
+    """Called by the atomic writer at named commit points."""
+    if _plan is not None and _plan.kill_at_point == name:
+        _plan.fired.append(("kill_at_point", name))
+        raise ChaosInterrupt(f"chaos: killed checkpoint commit at {name!r}")
+
+
+def consume_nan_grad_step():
+    """One poisoned optimizer step; returns True while the budget lasts."""
+    if _plan is None or _plan.nan_grad_steps <= 0:
+        return False
+    _plan.nan_grad_steps -= 1
+    _plan.fired.append(("nan_grads", _plan.nan_grad_steps))
+    return True
+
+
+def corrupt_file(path, offset=0, nbytes=4):
+    """Flip ``nbytes`` bytes of ``path`` in place (silent bit rot)."""
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        chunk = f.read(nbytes)
+        f.seek(offset)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    logger.warning(f"chaos: corrupted {nbytes} bytes of {path} at {offset}")
+
+
+def truncate_file(path, keep_bytes=0):
+    """Truncate ``path`` to ``keep_bytes`` (partial write / torn page)."""
+    with open(path, "r+b") as f:
+        f.truncate(keep_bytes)
+    logger.warning(f"chaos: truncated {path} to {keep_bytes} bytes")

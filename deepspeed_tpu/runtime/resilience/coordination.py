@@ -1,0 +1,54 @@
+"""Multi-host coordination primitives for checkpoint save/load.
+
+One discipline, shared by every multi-process checkpoint phase: a rank
+that fails must still reach the next collective — raising first would
+leave peers wedged in a barrier with no timeout.  So errors are swallowed
+locally, success flags are allgathered (the allgather is itself a
+barrier), and all ranks agree on the outcome before anyone proceeds or
+raises.  Both helpers are safe no-ops on single-process runs.
+"""
+
+# fixed-size buffer for broadcasting a checkpoint tag name across hosts
+# (collectives need identical shapes everywhere); tags are also directory
+# names, so NAME_MAX caps them at 255 bytes anyway — longer ones must be
+# skipped by the caller rather than truncated mid-codepoint
+TAG_BCAST_BYTES = 512
+
+
+def all_agree(ok):
+    """Allgather a local success flag; ``(agreed, n_failed)``.
+
+    ``agreed`` is True iff EVERY process reported success.  Single
+    process: ``(bool(ok), 0 or 1)`` with no collective.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return bool(ok), 0 if ok else 1
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray([bool(ok)], np.int32))
+    return bool(int(np.min(flags))), int(len(flags) - np.sum(flags))
+
+
+def broadcast_tag(name):
+    """Broadcast a tag name (or None) from process 0 to every host.
+
+    Returns the tag string, or None when process 0 passed a falsy value
+    (the 'no more candidates' sentinel).  Single process: passthrough.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return name or None
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros(TAG_BCAST_BYTES, np.uint8)
+    raw = str(name or "").encode()
+    buf[:len(raw)] = np.frombuffer(raw, np.uint8)
+    out = multihost_utils.broadcast_one_to_all(buf)
+    return np.asarray(out, np.uint8).tobytes().rstrip(b"\0").decode() \
+        or None

@@ -1,0 +1,74 @@
+"""Forward-compat shims for older jax releases.
+
+The codebase targets the current jax API (``jax.set_mesh``,
+``jax.shard_map``, ``jax.sharding.get_abstract_mesh``,
+``pallas.tpu.CompilerParams``).  On older installs (<= 0.4.x) those names
+are missing but equivalents exist; ``ensure_compat()`` installs aliases so
+one source tree runs on both.  Idempotent and cheap after the first call.
+"""
+_installed = False
+
+
+def ensure_compat():
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    import jax
+
+    if not hasattr(jax, "set_mesh"):
+        # ``with jax.set_mesh(m):`` == the classic ``with m:`` resource-env
+        # context on old jax; Mesh has always been a context manager
+        jax.set_mesh = lambda mesh: mesh
+
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        def _get_abstract_mesh():
+            from jax._src.mesh import thread_resources
+
+            physical = thread_resources.env.physical_mesh
+            return physical.abstract_mesh
+        jax.sharding.get_abstract_mesh = _get_abstract_mesh
+
+    if not hasattr(jax.lax, "axis_size"):
+        # psum of a concrete python scalar over a named axis is
+        # constant-folded to the axis size on old jax — no collective runs
+        jax.lax.axis_size = lambda axis_name: jax.lax.psum(1, axis_name)
+
+    if not hasattr(jax.lax, "pcast"):
+        # varying-manifest casts predate old jax's shard_map; with
+        # replication checking off (check_rep=False below) the cast is a
+        # type-system no-op
+        jax.lax.pcast = lambda x, axes, to=None: x
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _legacy_sm
+
+        def shard_map(f, *, mesh=None, in_specs, out_specs, axis_names=None,
+                      check_rep=False, **_kw):
+            def call(*args):
+                m = mesh
+                if m is None:
+                    from jax._src.mesh import thread_resources
+
+                    m = thread_resources.env.physical_mesh
+                    assert m is not None and not m.empty, \
+                        "jax.shard_map without mesh= needs an active mesh " \
+                        "context (with jax.set_mesh(...))"
+                auto = frozenset()
+                if axis_names is not None:
+                    auto = frozenset(a for a in m.axis_names
+                                     if a not in axis_names)
+                return _legacy_sm(f, mesh=m, in_specs=in_specs,
+                                  out_specs=out_specs, check_rep=check_rep,
+                                  auto=auto)(*args)
+            return call
+        jax.shard_map = shard_map
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        if not hasattr(pltpu, "CompilerParams") and \
+                hasattr(pltpu, "TPUCompilerParams"):
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except ImportError:  # pragma: no cover - pallas not built for platform
+        pass

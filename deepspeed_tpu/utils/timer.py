@@ -16,7 +16,7 @@ def _device_sync():
 
     try:
         jnp.zeros(()).block_until_ready()
-    except Exception:  # device not initialised yet; wall clock only
+    except RuntimeError:  # device not initialised yet; wall clock only
         pass
 
 

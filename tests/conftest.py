@@ -11,6 +11,30 @@ import os
 # jax_platforms; opt out with DSTPU_TEST_PLATFORM=tpu to run on real hardware
 _platform = os.environ.get("DSTPU_TEST_PLATFORM", "cpu")
 os.environ["JAX_PLATFORMS"] = _platform
+def _jax_has_num_cpu_devices_config():
+    # decided BEFORE importing jax (the XLA flag must be in the env first);
+    # the jax_num_cpu_devices config option landed in jax 0.5
+    try:
+        from importlib.metadata import version
+
+        major, minor = (int(p) for p in version("jax").split(".")[:2])
+        return (major, minor) >= (0, 5)
+    except Exception:
+        return False
+
+
+_use_xla_flag = False
+if _platform == "cpu":
+    # jax >= 0.5 rejects setting BOTH the XLA flag and jax_num_cpu_devices,
+    # so exactly one mechanism is used: the flag on older jax (which only
+    # honors the flag, set before the backend initializes) or one already
+    # present in the user's XLA_FLAGS, else the config option below
+    _flags = os.environ.get("XLA_FLAGS", "")
+    _use_xla_flag = "xla_force_host_platform_device_count" in _flags
+    if not _use_xla_flag and not _jax_has_num_cpu_devices_config():
+        os.environ["XLA_FLAGS"] = \
+            (_flags + " --xla_force_host_platform_device_count=8").strip()
+        _use_xla_flag = True
 
 import jax  # noqa: E402
 
@@ -19,8 +43,16 @@ if _platform == "cpu":
     # jax_platforms at import ("axon,cpu") even when JAX_PLATFORMS=cpu is set;
     # only an explicit config update wins.
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    if not _use_xla_flag:
+        try:
+            jax.config.update("jax_num_cpu_devices", 8)
+        except AttributeError:  # version sniff was wrong; tests then see a
+            pass                # 1-device mesh and fail loudly, not at import
 jax.config.update("jax_threefry_partitionable", True)
+
+from deepspeed_tpu.utils.jax_compat import ensure_compat  # noqa: E402
+
+ensure_compat()
 
 import pytest  # noqa: E402
 

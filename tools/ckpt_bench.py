@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Checkpoint save/load wall-clock: legacy in-place layout vs the atomic
+manifest+checksum commit path (ISSUE 1 bench satellite: the resilience
+tax must stay <10%).
+
+Runs on the virtual CPU mesh; emits a markdown row per (backend, mode).
+
+    python tools/ckpt_bench.py --hidden 768 --repeats 5
+"""
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+
+def build_engine(hidden, resilience):
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel, random_dataloader
+
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "steps_per_print": 1000,
+        "resilience": resilience,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden), config_params=cfg)
+    it = random_dataloader(hidden, 16, 8)
+    loss = engine.forward(next(it))
+    engine.backward(loss)
+    engine.step()
+    return engine, it
+
+
+def bench(engine, it, backend, repeats):
+    import deepspeed_tpu  # noqa: F401  (kept hot)
+
+    saves, loads = [], []
+    for r in range(repeats):
+        d = tempfile.mkdtemp(prefix="ckptbench-")
+        try:
+            t0 = time.perf_counter()
+            engine.save_checkpoint(d, tag=f"t{r}", backend=backend)
+            saves.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            engine.load_checkpoint(d, tag=f"t{r}")
+            loads.append(time.perf_counter() - t0)
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    return min(saves), min(loads)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hidden", type=int, default=768)
+    ap.add_argument("--repeats", type=int, default=5)
+    args = ap.parse_args()
+
+    # three save modes: legacy in-place, atomic manifest+checksum (the
+    # <10%-budget item), and atomic+fsync (durability; amortizes with
+    # checkpoint size).  Loads: legacy trust vs manifest-verified.
+    modes = [
+        ("legacy", {"atomic_checkpoints": False, "verify_on_load": False}),
+        ("atomic", {"atomic_checkpoints": True, "fsync": False,
+                    "verify_on_load": True}),
+        ("atomic+fsync", {"atomic_checkpoints": True, "fsync": True,
+                          "verify_on_load": True}),
+    ]
+    rows = []
+    for backend in ("npz", "orbax"):
+        results = {}
+        for name, res in modes:
+            engine, it = build_engine(args.hidden, res)
+            results[name] = bench(engine, it, backend, args.repeats)
+        s0, l0 = results["legacy"]
+        rows.append((backend, [(name, *results[name]) for name, _ in modes],
+                     s0, l0))
+
+    print(f"hidden={args.hidden} repeats={args.repeats} (min of repeats)")
+    print("| backend | mode | save | Δsave | load | Δload |")
+    print("|---|---|---|---|---|---|")
+    for backend, per_mode, s0, l0 in rows:
+        for name, s, l in per_mode:
+            print(f"| {backend} | {name} | {s * 1e3:.1f} ms "
+                  f"| {(s / s0 - 1) * 100:+.1f}% | {l * 1e3:.1f} ms "
+                  f"| {(l / l0 - 1) * 100:+.1f}% |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

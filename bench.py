@@ -363,6 +363,75 @@ def _attempt_cmd(base, spec):
     return cmd
 
 
+def _run_attempt(cmd, env, total_timeout, import_timeout):
+    """One worker attempt with phase-aware budgets.
+
+    The r05 failure mode: the attempt died at phase=importing_jax after
+    eating the WHOLE compile budget — a wedged tunnel during import looks
+    identical to a slow compile under a single timeout.  So the import
+    phase gets its own (much smaller) budget: if the worker hasn't
+    reported a phase past importing_jax within ``import_timeout`` seconds
+    it is killed immediately and the failure is attributed to the import
+    phase (which the retry logic treats as a transient backend issue).
+
+    Returns (rc, stdout, stderr, phases, timed_out) where ``phases`` is
+    [(name, seconds_since_spawn), ...] — wall-clock per phase is derivable
+    and always reported in the output JSON, success or failure.
+    """
+    import threading
+
+    t0 = time.time()
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    stderr_lines, stdout_chunks = [], []
+    phases = []
+
+    def _read_stderr():
+        for line in proc.stderr:
+            stderr_lines.append(line)
+            if line.startswith("PHASE:"):
+                phases.append((line[len("PHASE:"):].strip(),
+                               round(time.time() - t0, 1)))
+
+    def _read_stdout():
+        stdout_chunks.append(proc.stdout.read())
+
+    threads = [threading.Thread(target=_read_stderr, daemon=True),
+               threading.Thread(target=_read_stdout, daemon=True)]
+    for th in threads:
+        th.start()
+    timed_out = False
+    while True:
+        rc = proc.poll()
+        if rc is not None:
+            break
+        elapsed = time.time() - t0
+        still_importing = not phases or phases[-1][0] == "importing_jax"
+        if elapsed > total_timeout or \
+                (still_importing and elapsed > import_timeout):
+            timed_out = True
+            proc.kill()
+            proc.wait()
+            rc = -1
+            break
+        time.sleep(0.5)
+    for th in threads:
+        th.join(timeout=10)
+    return rc, "".join(stdout_chunks), "".join(stderr_lines), phases, \
+        timed_out
+
+
+def _phase_timings(phases, elapsed_s):
+    """[(name, at_s)] -> [{phase, at_s, dur_s}] (last phase runs to the
+    end of the attempt)."""
+    out = []
+    for i, (name, at) in enumerate(phases):
+        end = phases[i + 1][1] if i + 1 < len(phases) else elapsed_s
+        out.append({"phase": name, "at_s": at,
+                    "dur_s": round(max(0.0, end - at), 1)})
+    return out
+
+
 def run_parent(args) -> int:
     # attempt ladder: requested config first (round-4 tuned: batch 48 +
     # chunked LM head reached 60.2 TFLOPS/chip, 0.94 vs baseline, on a
@@ -410,38 +479,32 @@ def run_parent(args) -> int:
         init_retries = args.init_retries
         while True:
             t0 = time.time()
-            try:
-                proc = subprocess.run(
-                    _attempt_cmd(args, spec), env=env,
-                    capture_output=True, text=True, timeout=spec["timeout"])
-                timed_out = False
-                stderr, stdout = proc.stderr, proc.stdout
-                rc = proc.returncode
-            except subprocess.TimeoutExpired as e:
-                timed_out = True
-                stderr = (e.stderr or b"")
-                stderr = stderr.decode() if isinstance(stderr, bytes) else stderr
-                stdout = ""
-                rc = -1
-            phases = [l.split("PHASE:", 1)[1] for l in stderr.splitlines()
-                      if l.startswith("PHASE:")]
-            last_phase = phases[-1] if phases else "spawn"
+            rc, stdout, stderr, phases, timed_out = _run_attempt(
+                _attempt_cmd(args, spec), env, spec["timeout"],
+                min(args.import_budget_s, spec["timeout"]))
+            elapsed = round(time.time() - t0, 1)
+            timings = _phase_timings(phases, elapsed)
+            last_phase = phases[-1][0] if phases else "spawn"
             if rc == 0 and stdout.strip():
-                # success: forward the worker's JSON line verbatim (a
-                # non-JSON last line counts as a failed attempt, keeping
-                # the structured-failure contract)
+                # success: forward the worker's JSON line, annotated with
+                # the per-phase wall-clock (a non-JSON last line counts as
+                # a failed attempt, keeping the structured-failure contract)
                 line = stdout.strip().splitlines()[-1]
                 try:
-                    json.loads(line)
-                    print(line, flush=True)
+                    payload = json.loads(line)
+                    if not isinstance(payload, dict):
+                        raise ValueError("worker JSON is not an object")
+                    payload["phase_timings"] = timings
+                    print(json.dumps(payload), flush=True)
                     return 0
                 except ValueError:
                     stderr += f"\n[bench] non-JSON worker output: {line[:200]}"
             err_tail = "\n".join(stderr.strip().splitlines()[-6:])
             errors.append({
                 "attempt": ai, "model": spec["model"],
-                "timed_out": timed_out, "elapsed_s": round(time.time() - t0, 1),
+                "timed_out": timed_out, "elapsed_s": elapsed,
                 "last_phase": last_phase, "rc": rc,
+                "phase_timings": timings,
                 "stderr_tail": err_tail[-800:],
             })
             print(f"[bench] attempt {ai} ({spec['model']}) failed at "
@@ -484,6 +547,11 @@ def main():
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--budget_s", type=int, default=1500,
                    help="wall-clock budget for the primary attempt")
+    p.add_argument("--import-budget-s", type=int, default=300,
+                   help="budget for the jax-import phase alone (r05: a "
+                        "wedged tunnel during import ate the whole compile "
+                        "budget with no partials); import overruns are "
+                        "killed early and retried as backend flakes")
     p.add_argument("--init-retries", type=int, default=4)
     p.add_argument("--retry-wait-s", type=int, default=60,
                    help="round-4: the axon tunnel was observed wedged for "

@@ -115,12 +115,20 @@ def constrain(x, spec):
     if auto is None:  # pragma: no cover - older jax
         manual = set(getattr(mesh, "manual_axes", ()) or ())
         auto = tuple(a for a in mesh.shape if a not in manual)
+    # old jax's abstract mesh knows nothing about the legacy shard_map
+    # wrapping this trace — its manual axes are tracked by the compat shim
+    # and must be dropped too (empty set on new jax)
+    from deepspeed_tpu.utils.jax_compat import current_manual_axes
+
+    compat_manual = current_manual_axes()
 
     def keep(axis):
         if axis is None:
             return None
         axes = axis if isinstance(axis, tuple) else (axis,)
-        kept = tuple(a for a in axes if a in mesh.shape and a in auto)
+        kept = tuple(a for a in axes
+                     if a in mesh.shape and a in auto
+                     and a not in compat_manual)
         if not kept:
             return None
         return kept if len(kept) > 1 else kept[0]

@@ -88,6 +88,7 @@ class FlopsProfiler:
         self._params = None
         self._duration = None
         self._cost = {}
+        self._comm = None
         self._started = None
 
     # --- measurement --------------------------------------------------
@@ -120,6 +121,14 @@ class FlopsProfiler:
         return {name: analyze_jit(fn, *args)
                 for name, (fn, args) in named_fns.items()}
 
+    def profile_comm(self, report: Optional[Dict[str, Any]]):
+        """Attach an analytic comm-volume report (the dict produced by
+        DeepSpeedEngine.comm_volume_report / runtime.comm_accounting):
+        per-step wire bytes show up in print_model_profile alongside the
+        compute numbers."""
+        self._comm = report
+        return report
+
     # --- reference-API surface ---------------------------------------
     def start_profile(self, ignore_list=None):
         self._started = time.time()
@@ -134,6 +143,7 @@ class FlopsProfiler:
     def reset_profile(self):
         self._flops = self._params = self._duration = None
         self._cost = {}
+        self._comm = None
 
     def get_total_flops(self, as_string=False):
         return flops_to_string(self._flops) if as_string else (self._flops or 0)
@@ -171,6 +181,18 @@ class FlopsProfiler:
                     "output_bytes"):
             if self._cost.get(key):
                 lines.append(f"{key:<31} {_fmt(self._cost[key])}B")
+        if self._comm:
+            lines.append(f"Comm bytes/step (analytic):     "
+                         f"{_fmt(self._comm['total_bytes_per_step'])}B")
+            lines.append(f"  grad exchange:                "
+                         f"{_fmt(self._comm['grad_exchange_bytes_per_step'])}B")
+            red = self._comm.get("grad_reduction_vs_fp32")
+            if red:
+                lines.append(f"  vs fp32 dense exchange:       {red:.2f}x")
+            if self._comm.get("inter_bytes_per_step"):
+                lines.append(
+                    f"  cross-group (inter) bytes:    "
+                    f"{_fmt(self._comm['inter_bytes_per_step'])}B")
         lines.append("-" * 78)
         for line in lines:
             logger.info(line)

@@ -225,9 +225,30 @@ class DeepSpeedEngine:
     def amp_enabled(self):
         return self._config.amp_enabled
 
+    @property
+    def _live_state(self):
+        """The alive TrainState: between forward() and backward() the micro
+        jit has donated self.state's buffers into the staged state, so
+        mid-window readers (loss_scale, skipped_steps, eval) must look at
+        the staged one.  The scaler/skip counters are identical in both —
+        only apply moves them."""
+        return self._pending_state if self._pending_state is not None \
+            else self.state
+
     def loss_scale(self):
         if self.state is not None and self.state.scaler is not None:
-            return float(self.state.scaler.loss_scale)
+            # host-synced at most once per optimizer step (the scale only
+            # changes in apply): repeated reads — e.g. _report_progress at
+            # steps_per_print boundaries plus user polling — must not each
+            # pay a device round-trip
+            cached = getattr(self, "_scale_cache", None)
+            if cached is not None and cached[0] == self.global_steps:
+                return cached[1]
+            import jax
+
+            val = float(jax.device_get(self._live_state.scaler.loss_scale))
+            self._scale_cache = (self.global_steps, val)
+            return val
         return self._config.loss_scale or self._config.initial_dynamic_scale
 
     def dynamic_loss_scale(self):
@@ -265,6 +286,15 @@ class DeepSpeedEngine:
 
     def zero_load_from_fp32_weights(self):
         return self._config.zero_config.load_from_fp32_weights
+
+    def zero_quantized_gradients(self):
+        return self._config.zero_config.quantized_gradients
+
+    def zero_quantized_weights(self):
+        return self._config.zero_config.quantized_weights
+
+    def zero_hierarchical_allreduce(self):
+        return self._config.zero_config.hierarchical_allreduce
 
     def allreduce_always_fp32(self):
         return self._config.allreduce_always_fp32
@@ -331,14 +361,24 @@ class DeepSpeedEngine:
 
     @property
     def skipped_steps(self):
-        """Overflow-skipped step count; lives on-device in the train state
-        (synced on access, not per step)."""
+        """Overflow-skipped step count; lives on-device in the train state.
+        The device scalar is fetched at most once per optimizer step (the
+        counter only moves in apply, which also bumps global_steps) and the
+        host value is served from cache after that — the 1-bit freeze probe
+        and _report_progress read this repeatedly without extra syncs.
+        Checkpoint loads drop the cache explicitly."""
         if self.state is None:
             return 0
+        key = (self.global_steps, getattr(self, "_host_skipped", 0))
+        cached = getattr(self, "_skipped_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
         import jax
 
-        return int(jax.device_get(self.state.skipped_steps)) \
+        val = int(jax.device_get(self._live_state.skipped_steps)) \
             + getattr(self, "_host_skipped", 0)
+        self._skipped_cache = (key, val)
+        return val
 
     def get_lr(self):
         return [self._current_lr()]
@@ -532,6 +572,7 @@ class DeepSpeedEngine:
                         if self._use_loss_scaler() else None),
                 skipped_steps=rep, rng=rep)
             self._batch_sharding_cache = {}
+            self._arm_quantized_collectives(stage, dp)
             return self._shardings
         # sparse_gradients under plain DP (reference engine.py:1227-1265
         # swaps the embedding-grad all-reduce for a sparse all-gather): the
@@ -577,7 +618,88 @@ class DeepSpeedEngine:
                     if self._use_loss_scaler() else None),
             skipped_steps=rep, rng=rep)
         self._batch_sharding_cache = {}
+        self._arm_quantized_collectives(stage, dp)
         return self._shardings
+
+    def _arm_quantized_collectives(self, stage, dp):
+        """Decide whether the ZeRO++-style quantized collectives run
+        (qgZ: int8 gradient reduce-scatter; qwZ: int8 offload param
+        all-gather) and resolve the hierarchical intra-group size.  Asked-for
+        compression silently no-oping would defeat the user's intent, so
+        every blocker is named loudly (same discipline as the OneBitAdam
+        wire arming above)."""
+        import math
+
+        import jax
+
+        zc = self._config.zero_config
+        self._qgz_armed = False
+        self._qgz_intra = 0
+        self._qwz_armed = False
+        if zc.quantized_gradients:
+            blockers = []
+            if dp <= 1:
+                blockers.append("data-parallel degree is 1")
+            if stage != 2:
+                blockers.append(
+                    f"zero_optimization.stage={stage} (qgZ quantizes the "
+                    f"stage-2 sharded-accumulator reduce-scatter)")
+            if self._offload:
+                blockers.append("cpu_offload=true (gradients stream D2H, "
+                                "no collective to quantize)")
+            if getattr(self, "_csr_dp_flags", None) is not None:
+                blockers.append("sparse_gradients CSR exchange is armed")
+            if self.mesh.shape.get("pipe", 1) != 1:
+                blockers.append(f"pipe={self.mesh.shape.get('pipe')}")
+            if self.sp_world_size != 1:
+                blockers.append(f"seq={self.sp_world_size}")
+            if blockers:
+                log_dist(
+                    "ZeRO qgZ: quantized_gradients DISARMED — gradients "
+                    f"move dense ({', '.join(blockers)}); the quantized "
+                    "reduce-scatter requires zero stage 2, no cpu_offload, "
+                    "and pipe=seq=1", ranks=[0], level=logging.WARNING)
+            else:
+                self._qgz_armed = True
+        if zc.quantized_weights:
+            if self._offload and dp > 1:
+                self._qwz_armed = True
+            else:
+                blocker = "cpu_offload=false (the int8 weight gather rides " \
+                          "the offload parameter push)" \
+                    if not self._offload else "data-parallel degree is 1"
+                log_dist(
+                    f"ZeRO qwZ: quantized_weights DISARMED — parameters "
+                    f"move in the compute dtype ({blocker})",
+                    ranks=[0], level=logging.WARNING)
+        if zc.hierarchical_allreduce and self._qgz_armed:
+            k = zc.hierarchical_intra_size
+            auto = k <= 0
+            if auto:
+                # auto: co-located ranks (consecutive on the 'data' axis)
+                # form the intra group
+                k = math.gcd(dp, jax.local_device_count())
+            if 1 < k < dp and dp % k == 0:
+                self._qgz_intra = k
+            elif not auto:
+                log_dist(
+                    f"ZeRO qgZ: hierarchical_allreduce requested but "
+                    f"hierarchical_intra_size={k} cannot form >=2 groups "
+                    f"over the data axis ({dp}; needs 1 < k < {dp} with k "
+                    f"dividing it); using the flat quantized all_to_all",
+                    ranks=[0], level=logging.WARNING)
+            # auto + degenerate (e.g. single host: every rank is intra)
+            # falls back flat silently — nothing was misconfigured
+        elif zc.hierarchical_allreduce:
+            # the knob shapes the QUANTIZED exchange only — say so instead
+            # of silently ignoring it
+            why = "quantized_gradients is disarmed (see warning above)" \
+                if zc.quantized_gradients else \
+                "zero_optimization.quantized_gradients is not enabled"
+            log_dist(
+                f"ZeRO qgZ: hierarchical_allreduce has no effect — it "
+                f"routes the quantized gradient exchange and {why}",
+                ranks=[0], level=logging.WARNING)
 
     def _use_loss_scaler(self):
         return self.fp16_enabled()
@@ -795,6 +917,8 @@ class DeepSpeedEngine:
 
         csr_exchange = self._make_csr_grad_exchange() \
             if getattr(self, "_csr_dp_flags", None) is not None else None
+        qgz_exchange = self._make_quantized_grad_exchange() \
+            if getattr(self, "_qgz_armed", False) else None
 
         def micro(state: TrainState, batch):
             rng = jax.random.fold_in(state.rng, state.micro_step + state.step * 131071)
@@ -803,6 +927,8 @@ class DeepSpeedEngine:
 
             if csr_exchange is not None:
                 grads, loss = csr_exchange(state.params, batch, rng, scale)
+            elif qgz_exchange is not None:
+                grads, loss = qgz_exchange(state.params, batch, rng, scale)
             else:
                 def loss_fn(params):
                     loss, metrics = model.loss(params, batch, rng, train=True)
@@ -904,6 +1030,82 @@ class DeepSpeedEngine:
                 body, mesh=mesh,
                 in_specs=(pspec, batch_spec, P(), P()),
                 out_specs=(pspec, P()),
+                axis_names={"data"}, check_vma=False)(params, batch, rng,
+                                                      scale)
+
+        return run
+
+    def _accum_data_dims(self):
+        """Per-leaf dim the ZeRO accumulator spec shards over 'data' (None =
+        replicated leaf).  Drives which gradient leaves ride the quantized
+        reduce-scatter and where their shard lands."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        return jax.tree_util.tree_map(
+            _spec_data_dim, self._shardings.accum,
+            is_leaf=lambda x: isinstance(x, NamedSharding))
+
+    def _make_quantized_grad_exchange(self):
+        """Gradient computation + exchange with 'data' manual: the stage-2
+        reduce-scatter becomes quantize -> all_to_all -> local reduce ->
+        dequantize (the ZeRO++ qgZ shape, custom_collectives.
+        quantized_reduce_scatter), optionally hierarchical.  Shardable
+        leaves come back as the device's fp32 accumulator shard (out_specs
+        put 'data' on the same dim the ZeRO accum spec shards), so the
+        downstream accum add is collective-free; leaves too small to shard
+        pmean densely as GSPMD would.
+
+        Wire bytes drop ~4x vs the fp32 reduce-scatter (int8 + per-block
+        fp32 scales at block 128) — asserted analytically by
+        comm_volume_report() and tests/unit/test_quantization.py."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.runtime.custom_collectives import \
+            quantized_reduce_scatter
+
+        mesh = self.mesh
+        gas = self.gradient_accumulation_steps()
+        model = self.module
+        dp = self.dp_world_size
+        block = self._config.zero_config.quantization_block_size
+        intra = getattr(self, "_qgz_intra", 0)
+        state_spec = self._onebit_state_spec()
+        pspec = state_spec.params
+        grads_out_spec = state_spec.accum
+        dims = self._accum_data_dims()
+
+        def body(params, batch, rng, scale):
+            rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+
+            def loss_fn(p):
+                loss, _ = model.loss(p, batch, rng, train=True)
+                return loss.astype(jnp.float32) * scale / gas, loss
+
+            grads, loss = jax.grad(loss_fn, has_aux=True)(params)
+
+            def exchange(dim, g):
+                if dim is None:
+                    return jax.lax.pmean(g, "data")
+                return quantized_reduce_scatter(
+                    g, "data", dim=dim, block_size=block, intra_size=intra)
+
+            # is_leaf: a None dim means "replicated leaf", not an empty
+            # subtree — without it tree_map drops the entry entirely
+            grads = jax.tree_util.tree_map(exchange, dims, grads,
+                                           is_leaf=lambda x: x is None)
+            return grads, jax.lax.pmean(loss, "data")
+
+        def run(params, batch, rng, scale):
+            batch_spec = jax.tree_util.tree_map(
+                lambda x: P() if x.ndim == 0 else
+                P(*(["data"] + [None] * (x.ndim - 1))), batch)
+            return jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(pspec, batch_spec, P(), P()),
+                out_specs=(grads_out_spec, P()),
                 axis_names={"data"}, check_vma=False)(params, batch, rng,
                                                       scale)
 
@@ -1090,36 +1292,153 @@ class DeepSpeedEngine:
                                       dtype=np.float32))
         return out
 
-    def _push_local_params(self):
-        """Upload this process's updated master slices in the compute dtype
-        and all-gather to the replicated/TP param layout on device — H2D
-        traffic is O(params/dp) per process, the gather rides ICI."""
+    def _qwz_leaf_meta(self):
+        """Static per-leaf plan for the quantized (qwZ) parameter push.
+
+        A leaf rides the int8 gather when its offload sharding is a pure
+        'data' split on one dim (TP-mixed leaves keep the dense path — they
+        are exotic under offload and the flat int8 layout assumes shard ==
+        data coordinate).  Cached; layouts are static."""
         import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deepspeed_tpu.runtime import quantization as qz
+
+        if getattr(self, "_qwz_meta", None) is not None:
+            return self._qwz_meta
+        dp = self.dp_world_size
+        block = self._config.zero_config.quantization_block_size
+        sh_flat = jax.tree_util.tree_leaves(self._offload_region_sh)
+        metas = []
+        for master, gsh in zip(self._host_master_flat, sh_flat):
+            spec_axes = [(a if isinstance(a, tuple) else (a,))
+                         for a in gsh.spec if a is not None]
+            flat_axes = [x for axes in spec_axes for x in axes]
+            if flat_axes != ["data"] or master.ndim == 0:
+                metas.append(None)
+                continue
+            d = [i for i, a in enumerate(gsh.spec) if a is not None][0]
+            s_d = master.shape[d]
+            if s_d % dp != 0:
+                metas.append(None)
+                continue
+            nloc = master.size // dp
+            bs, nb, npad = qz.block_layout(nloc, block)
+            metas.append({
+                "dim": d, "shard_rows": s_d // dp, "nloc": nloc,
+                "bs": bs, "nb": nb, "npad": npad,
+                "q_sh": NamedSharding(self.mesh, P("data")),
+            })
+        self._qwz_meta = metas
+        return metas
+
+    def _build_param_gather(self):
+        """The jitted shard->replicated parameter materialization for the
+        offload step.  Dense leaves are an identity whose out_shardings make
+        XLA all-gather the compute-dtype shards; qwZ leaves arrive as flat
+        int8 blocks + fp32 scales, are FORCED replicated while still int8
+        (the sharding constraint pins the all-gather to the 1-byte payload)
+        and dequantize locally afterwards."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+        dp = self.dp_world_size
+        compute_dtype = self.compute_dtype
+        param_sh_flat = jax.tree_util.tree_leaves(self._shardings.params)
+        leaf_shapes = [tuple(m.shape) for m in self._host_master_flat]
+        metas = self._qwz_leaf_meta() if self._qwz_armed \
+            else [None] * len(leaf_shapes)
+        rep = NamedSharding(mesh, P())
+
+        def gather(dense_arrs, q_arrs, s_arrs):
+            outs = [None] * len(metas)
+            di = qi = 0
+            for i, meta in enumerate(metas):
+                if meta is None:
+                    outs[i] = dense_arrs[di]
+                    di += 1
+                    continue
+                q = jax.lax.with_sharding_constraint(q_arrs[qi], rep)
+                s = jax.lax.with_sharding_constraint(s_arrs[qi], rep)
+                qi += 1
+                rows = (q.reshape(dp, meta["nb"], meta["bs"])
+                        .astype(jnp.float32)
+                        * s.reshape(dp, meta["nb"])[:, :, None])
+                rows = rows.reshape(dp, meta["npad"])[:, :meta["nloc"]]
+                shape = leaf_shapes[i]
+                d = meta["dim"]
+                # pieces were flattened host-side with dim d moved to the
+                # front, so shard rows stack contiguously along that dim
+                moved = (shape[d],) + shape[:d] + shape[d + 1:]
+                full = rows.reshape((shape[d],) + moved[1:])
+                outs[i] = jnp.moveaxis(full, 0, d).astype(compute_dtype)
+            return outs
+
+        return jax.jit(gather, out_shardings=param_sh_flat)
+
+    def _push_local_params(self):
+        """Upload this process's updated master slices and all-gather to the
+        replicated/TP param layout on device — H2D traffic is O(params/dp)
+        per process, the gather rides ICI.  With zero_optimization.
+        quantized_weights (qwZ, ZeRO++ arxiv 2306.10209 §4.1) eligible
+        leaves upload and gather as blockwise int8 + fp32 scales instead of
+        the compute dtype, shrinking both the H2D copy and the on-wire
+        all-gather ~2-4x; dequantization to the compute dtype happens
+        replicated, after the gather."""
+        import jax
+
+        from deepspeed_tpu.runtime import quantization as qz
 
         dtype_name = str(jax.numpy.dtype(self.compute_dtype))
         sh_flat = jax.tree_util.tree_leaves(self._offload_region_sh)
-        param_sh_flat = jax.tree_util.tree_leaves(self._shardings.params)
-        sharded = []
-        for i, (master, gsh) in enumerate(zip(self._host_master_flat,
-                                              sh_flat)):
+        metas = self._qwz_leaf_meta() if self._qwz_armed \
+            else [None] * len(self._host_master_flat)
+        block = self._config.zero_config.quantization_block_size
+        dense_arrs, q_arrs, s_arrs = [], [], []
+        for master, gsh, meta in zip(self._host_master_flat, sh_flat,
+                                     metas):
             imap = gsh.devices_indices_map(tuple(master.shape))
+            if meta is None:
+                pieces = {}
+                for d in gsh.addressable_devices:
+                    idx = imap[d]
+                    key = tuple((s.start, s.stop, s.step) for s in idx)
+                    if key not in pieces:
+                        pieces[key] = self.optimizer.cast_to(
+                            [master[idx]], dtype_name)[0]
+                arrs = [jax.device_put(pieces[tuple(
+                            (s.start, s.stop, s.step) for s in imap[d])], d)
+                        for d in gsh.addressable_devices]
+                dense_arrs.append(jax.make_array_from_single_device_arrays(
+                    tuple(master.shape), gsh, arrs))
+                continue
+            npad, nb = meta["npad"], meta["nb"]
+            rows = meta["shard_rows"]
+            d_dim = meta["dim"]
             pieces = {}
-            for d in gsh.addressable_devices:
-                idx = imap[d]
-                key = tuple((s.start, s.stop, s.step) for s in idx)
-                if key not in pieces:
-                    pieces[key] = self.optimizer.cast_to(
-                        [master[idx]], dtype_name)[0]
-            arrs = [jax.device_put(pieces[tuple(
-                        (s.start, s.stop, s.step) for s in imap[d])], d)
-                    for d in gsh.addressable_devices]
-            sharded.append(jax.make_array_from_single_device_arrays(
-                tuple(master.shape), gsh, arrs))
+            for dev in gsh.addressable_devices:
+                coord = imap[dev][d_dim].start // rows
+                if coord not in pieces:
+                    # flatten with the sharded dim leading so the gathered
+                    # rows stack contiguously (the gather jit's layout)
+                    pieces[coord] = qz.quantize_blockwise_np(
+                        np.moveaxis(master[imap[dev]], d_dim, 0), block)
+            q_parts, s_parts = [], []
+            for dev in gsh.addressable_devices:
+                coord = imap[dev][d_dim].start // rows
+                qp, sp = pieces[coord]
+                q_parts.append(jax.device_put(qp, dev))
+                s_parts.append(jax.device_put(sp, dev))
+            q_arrs.append(jax.make_array_from_single_device_arrays(
+                (self.dp_world_size * npad,), meta["q_sh"], q_parts))
+            s_arrs.append(jax.make_array_from_single_device_arrays(
+                (self.dp_world_size * nb,), meta["q_sh"], s_parts))
         if self._jit_param_gather is None:
-            self._jit_param_gather = jax.jit(
-                lambda xs: xs, out_shardings=param_sh_flat)
+            self._jit_param_gather = self._build_param_gather()
         with jax.set_mesh(self.mesh):
-            new_flat = self._jit_param_gather(sharded)
+            new_flat = self._jit_param_gather(dense_arrs, q_arrs, s_arrs)
         new_params = jax.tree_util.tree_unflatten(self._host_treedef,
                                                   new_flat)
         self.state = self.state._replace(params=new_params)
@@ -1379,7 +1698,7 @@ class DeepSpeedEngine:
                 "wire-compression path (post-freeze there is no dense "
                 "gradient to clip). Disable clipping, or set optimizer "
                 "params comm_backend_name='none' to keep the dense path.")
-        self._jit_micro = jax.jit(self._make_micro_fn(),
+        self._jit_micro = jax.jit(self._make_micro_fn(), donate_argnums=(0,),
                                   out_shardings=(sh, None))
         self._onebit_fused_fns = {b: self._make_onebit_fused(b)
                                   for b in (False, True)}
@@ -1429,17 +1748,24 @@ class DeepSpeedEngine:
             # own shard; accumulation happens host-side, overlapped with the
             # next micro-batch's device compute
             self._jit_micro = jax.jit(
-                self._make_micro_offload_fn(),
+                self._make_micro_offload_fn(), donate_argnums=(0,),
                 out_shardings=(sh, None, self._offload_grad_sh))
             self._jit_param_gather = None  # built on first step
             return
         micro = self._make_micro_fn()
         apply_ = self._make_apply_fn()
 
-        # NOTE: the micro step does NOT donate its input state — backward()
-        # commits the staged state, so forward() without backward() (eval,
-        # discarded micro-batch) must leave the accumulator untouched.
-        self._jit_micro = jax.jit(micro, out_shardings=(sh, None))
+        # donate_argnums on the micro step: params/opt_state/master pass
+        # through unchanged and alias input buffers, and the fp32
+        # accumulator updates in place — without donation every micro-batch
+        # copies the full TrainState (transient 2x peak HBM).  The staged
+        # forward()/backward() contract still holds (backward commits the
+        # staged state); the cost is that a forward whose result is
+        # DISCARDED (no backward) consumes the engine state — callers that
+        # want a grad-free forward must use engine.eval()/eval_loss, which
+        # never touch the train state.
+        self._jit_micro = jax.jit(micro, donate_argnums=(0,),
+                                  out_shardings=(sh, None))
         self._jit_apply = jax.jit(apply_, donate_argnums=(0,), out_shardings=(sh, None))
 
         gas = self.gradient_accumulation_steps()
@@ -1478,6 +1804,9 @@ class DeepSpeedEngine:
 
         prof = FlopsProfiler(engine=self)
         prof.profile_params(self.state.params)
+        comm_report = self.comm_volume_report()
+        prof.profile_comm(comm_report if comm_report["grad_path_modeled"]
+                          else None)
         micro = self._make_micro_offload_fn() if self._offload \
             else self._make_micro_fn()
         import jax
@@ -1488,6 +1817,115 @@ class DeepSpeedEngine:
                                  module_depth=cfg.module_depth,
                                  top_modules=cfg.top_modules,
                                  detailed=cfg.detailed)
+
+    # ------------------------------------------------------------------
+    # analytic comm-volume accounting (runtime/comm_accounting.py)
+    # ------------------------------------------------------------------
+    def _comm_leaf_specs(self):
+        """(LeafSpec list, qwZ-eligibility list) for the current state:
+        name, shape and the 'data'-sharded dim of every parameter leaf."""
+        import jax
+
+        from deepspeed_tpu.runtime import comm_accounting as ca
+
+        if self._offload:
+            sh_tree = self._offload_region_sh
+        else:
+            sh_tree = self._shardings.accum
+
+        from jax.sharding import NamedSharding
+
+        dims = jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+            _spec_data_dim, sh_tree,
+            is_leaf=lambda x: isinstance(x, NamedSharding)),
+            is_leaf=lambda x: x is None)
+        flat, _ = jax.tree_util.tree_flatten_with_path(self.state.params)
+        leaves = []
+        for (path, leaf), dim in zip(flat, dims):
+            parts = [str(getattr(p, "key", getattr(p, "idx",
+                                                   getattr(p, "name", p))))
+                     for p in path]
+            leaves.append(ca.LeafSpec(name="/".join(parts) or "param",
+                                      shape=tuple(leaf.shape),
+                                      shard_dim=dim))
+        qwz_ok = [m is not None for m in self._qwz_leaf_meta()] \
+            if (self._offload and getattr(self, "_qwz_armed", False)) \
+            else [False] * len(leaves)
+        return leaves, qwz_ok
+
+    def comm_volume_report(self, refresh=False):
+        """Analytic per-step communication volume of the ACTIVE config:
+        the exact bytes each device sends, per collective and per optimizer
+        step, computed from shapes/dtypes/mesh alone — deterministic on CPU
+        (no device or HLO needed), so quantized-collective byte wins are
+        assertable in tier-1 tests.
+
+        Covers the ZeRO gradient exchange (dense reduce-scatter/all-reduce
+        or the qgZ quantized all_to_alls, x gradient-accumulation steps)
+        and the per-step weight materialization (stage-1/2 compute-dtype
+        all-gather; the offload push, int8+scales under qwZ).  Not modeled:
+        the CSR-sparse and 1-bit wire paths (proved by HLO byte tests in
+        tests/unit/test_csr.py / test_onebit.py) and stage-3 per-use
+        parameter gathers (scheduled by XLA inside fwd/bwd).
+
+        Requires built state — call forward/train_batch/init_from_batch
+        first."""
+        assert self.state is not None, \
+            "call forward/train_batch once (or init_from_batch) before " \
+            "comm_volume_report"
+        if not refresh and getattr(self, "_comm_report", None) is not None:
+            return self._comm_report
+        from deepspeed_tpu.runtime import comm_accounting as ca
+
+        zc = self._config.zero_config
+        dp = self.dp_world_size
+        stage = self.zero_optimization_stage()
+        compute = np.dtype(self.compute_dtype).name
+        leaves, qwz_ok = self._comm_leaf_specs()
+        qwz_armed = getattr(self, "_qwz_armed", False)
+
+        report = ca.volume_report(
+            leaves, dp,
+            gas=self.gradient_accumulation_steps(),
+            quantized_gradients=getattr(self, "_qgz_armed", False),
+            quantized_weights=qwz_armed,
+            quantized_weights_mask=qwz_ok if qwz_armed else None,
+            block_size=zc.quantization_block_size,
+            intra_size=getattr(self, "_qgz_intra", 0),
+            param_dtype=compute,
+            gather_params=dp > 1 and (self._offload or stage in (1, 2)))
+        report["config"].update({"zero_stage": stage,
+                                 "compute_dtype": compute})
+        # the accounting models the dense/quantized ZeRO exchange; when the
+        # active gradient path is actually CSR-sparse or the 1-bit wire the
+        # dense numbers would overstate traffic 10-100x, so the report says
+        # so and the per-step metric is withheld (those paths' wins are
+        # proved by HLO byte tests instead)
+        report["grad_path_modeled"] = not (
+            getattr(self, "_csr_dp_flags", None) is not None
+            or getattr(self, "_offload_sparse_flags", None) is not None
+            or self._onebit_wire())
+        self._comm_report = report
+        return report
+
+    def _comm_bytes_per_step(self):
+        """Cached total for the per-step metrics dict; None when the active
+        gradient path is one the accounting does not model (CSR, 1-bit) —
+        consumers must not see a dense number for a compressed wire."""
+        if self.state is None:
+            return None
+        report = self.comm_volume_report()
+        return report["total_bytes_per_step"] \
+            if report["grad_path_modeled"] else None
+
+    def _annotate_comm(self, metrics):
+        """Copy a step's metrics dict and attach comm_bytes_per_step when
+        the accounting models the active wire path."""
+        metrics = dict(metrics)
+        comm = self._comm_bytes_per_step()
+        if comm is not None:
+            metrics["comm_bytes_per_step"] = comm
+        return metrics
 
     def train(self, mode=True):
         """torch-parity module mode (reference engine is an nn.Module):
@@ -1502,9 +1940,33 @@ class DeepSpeedEngine:
     def forward(self, batch):
         """Compute the micro-batch loss (grads are computed alongside and
         committed by backward(), keeping one-fwd-one-bwd cost parity).
-        In eval mode (engine.eval()) this is a grad-free forward."""
+        In eval mode (engine.eval()) this is a grad-free forward.
+
+        The micro step donates the engine state into the staged result, so
+        every train-mode forward() MUST be committed by backward() — a
+        grad-free/discardable forward is engine.eval() + forward (or
+        eval_loss), which never touches the train state."""
         if not self._train_mode:
             return self.eval_loss(batch)
+        if self._pending_state is not None:
+            # fail here with the real story, not deep in XLA with a cryptic
+            # "buffer was donated" once the dead state is passed back in
+            raise RuntimeError(
+                "forward() called twice without backward(): the micro step "
+                "donates the engine state into the staged result, so each "
+                "train-mode forward must be committed by backward() before "
+                "the next one; use engine.eval()/eval_loss for grad-free "
+                "forwards")
+        if self.state is not None and _tree_has_deleted(self.state,
+                                                       first_only=True):
+            # a failed donated micro execution invalidated the state with
+            # nothing staged (JAX deletes donated inputs at dispatch even
+            # when the computation errors) — retrying cannot work; say how
+            # to recover instead of surfacing XLA buffer errors
+            raise RuntimeError(
+                "engine state buffers were donated by a failed micro step; "
+                "restore with load_checkpoint(..., auto_resume=True) "
+                "before continuing")
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).start()
         if self.progressive_layer_drop is not None:
@@ -1526,8 +1988,8 @@ class DeepSpeedEngine:
                 self._pending_grads = grads
             else:
                 new_state, loss = self._jit_micro(self.state, dev_batch)
-        # torch-parity semantics: gradients only land when backward() commits
-        # the staged state; a forward without backward contributes nothing.
+        # torch-parity semantics: gradients land when backward() commits the
+        # staged state (the donated input buffers now live inside it).
         self._pending_state = new_state
         self._pending_loss = loss
         if self.wall_clock_breakdown():
@@ -1664,9 +2126,10 @@ class DeepSpeedEngine:
         self.global_steps += 1
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
-        self._last_metrics = {"overflow": not finite,
-                              "grad_norm": getattr(self, "_last_grad_norm", 0.0),
-                              "loss_scale": scale}
+        self._last_metrics = self._annotate_comm(
+            {"overflow": not finite,
+             "grad_norm": getattr(self, "_last_grad_norm", 0.0),
+             "loss_scale": scale})
         self._observe_step_outcome(loss=self._pending_loss,
                                    overflow=not finite)
         if self.global_steps % self.steps_per_print() == 0:
@@ -1686,7 +2149,7 @@ class DeepSpeedEngine:
         self.global_steps += 1
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
-        self._last_metrics = metrics
+        self._last_metrics = metrics = self._annotate_comm(metrics)
         self._last_grad_norm = metrics["grad_norm"]
         overflow = None
         if self.fp16_enabled():
@@ -1775,7 +2238,7 @@ class DeepSpeedEngine:
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
         self.micro_steps += gas
-        self._last_metrics = metrics
+        self._last_metrics = metrics = self._annotate_comm(metrics)
         self._last_grad_norm = metrics["grad_norm"]
         self.tput_timer.stop()
         # the fused path never syncs host-side; the overflow scalar is only
@@ -1803,7 +2266,9 @@ class DeepSpeedEngine:
 
             self._jit_eval = jax.jit(ev)
         with jax.set_mesh(self.mesh):
-            loss = self._jit_eval(self.state, self._shard_batch(batch))
+            # _live_state: a validation loss mid-accumulation must read the
+            # staged (alive) state, not the donated committed one
+            loss = self._jit_eval(self._live_state, self._shard_batch(batch))
         if self._watchdog is not None:
             # a long validation loop between optimizer steps is progress,
             # not a stalled step
@@ -2044,6 +2509,16 @@ class DeepSpeedEngine:
     def _assert_saveable(self):
         assert self.state is not None, \
             "nothing to save; train state not built"
+        assert self._pending_state is None, \
+            "save_checkpoint between forward() and backward(): the micro " \
+            "step donated the committed state's buffers — commit the " \
+            "in-flight micro-batch with backward() first"
+        if _tree_has_deleted(self.state):
+            raise RuntimeError(
+                "cannot checkpoint: the train state's buffers were donated "
+                "by a failed micro step; restore a previous checkpoint "
+                "(load_checkpoint(..., auto_resume=True)) instead of "
+                "saving the dead state")
 
     def _assert_loadable(self):
         assert self.state is not None, \
@@ -2370,7 +2845,23 @@ class DeepSpeedEngine:
             if self.lr_scheduler is not None else None,
         }
 
+    def _discard_staged_micro(self):
+        """Drop any in-flight forward() staging.  A recovery load must not
+        leave a stale staged state behind: the next forward() would refuse
+        ('called twice without backward') and backward() would commit
+        pre-failure buffers over the freshly loaded checkpoint."""
+        self._pending_state = None
+        self._pending_loss = None
+        self._pending_grads = None
+        if getattr(self, "_pending_fetches", None):
+            self._pending_fetches = []
+
     def _ckpt_state_restore(self, snap):
+        # a rollback can land on the same global_steps with different
+        # device counters — the host-side sync caches must not serve stale
+        self._skipped_cache = None
+        self._scale_cache = None
+        self._discard_staged_micro()
         self.state = snap["state"]
         self.global_steps = snap["global_steps"]
         self.micro_steps = snap["micro_steps"]
@@ -2468,6 +2959,12 @@ class DeepSpeedEngine:
         # pre-freeze tag must re-derive it from the restored counters, not
         # keep serving the compressed program through what is warmup again
         self._onebit_frozen_latch = False
+        # loaded device counters invalidate the host-side sync caches (the
+        # loaded tag may share global_steps with the pre-load state), and
+        # any staged micro-batch from before the load is dead weight
+        self._skipped_cache = None
+        self._scale_cache = None
+        self._discard_staged_micro()
         # skipped_steps restores with the device state (a TrainState leaf)
         if load_lr_scheduler_states and self.lr_scheduler is not None \
                 and meta.get("lr_scheduler") is not None:
@@ -2484,6 +2981,36 @@ class DeepSpeedEngine:
         load_checkpoint without training first)."""
         self._ensure_state(batch)
         self._compile()
+
+
+def _tree_has_deleted(tree, first_only=False):
+    """True if (any of / the first of) the pytree's jax arrays has had its
+    buffer deleted — the donated-then-failed signature.  ``first_only``
+    keeps the per-micro-step check O(1): donation invalidates every donated
+    input at dispatch, so one leaf is representative."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(tree):
+        is_deleted = getattr(leaf, "is_deleted", None)
+        if callable(is_deleted):
+            try:
+                if is_deleted():
+                    return True
+            except Exception:  # pragma: no cover - defensive: liveness
+                return True    # probe failing means the buffer is unusable
+            if first_only:
+                return False
+    return False
+
+
+def _spec_data_dim(sh):
+    """Dim index a NamedSharding's PartitionSpec puts 'data' on (None =
+    replicated over the data axis)."""
+    for d, axis in enumerate(sh.spec):
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        if axis is not None and "data" in axes:
+            return d
+    return None
 
 
 def _stack_batches(micros):

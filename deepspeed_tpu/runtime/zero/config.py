@@ -14,7 +14,17 @@ from deepspeed_tpu.runtime.zero.constants import (
     ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT,
     ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS,
     ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS_DEFAULT,
+    ZERO_OPTIMIZATION_HIERARCHICAL_ALLREDUCE,
+    ZERO_OPTIMIZATION_HIERARCHICAL_ALLREDUCE_DEFAULT,
+    ZERO_OPTIMIZATION_HIERARCHICAL_INTRA_SIZE,
+    ZERO_OPTIMIZATION_HIERARCHICAL_INTRA_SIZE_DEFAULT,
     ZERO_OPTIMIZATION_OVERLAP_COMM, ZERO_OPTIMIZATION_OVERLAP_COMM_DEFAULT,
+    ZERO_OPTIMIZATION_QUANTIZATION_BLOCK_SIZE,
+    ZERO_OPTIMIZATION_QUANTIZATION_BLOCK_SIZE_DEFAULT,
+    ZERO_OPTIMIZATION_QUANTIZED_GRADIENTS,
+    ZERO_OPTIMIZATION_QUANTIZED_GRADIENTS_DEFAULT,
+    ZERO_OPTIMIZATION_QUANTIZED_WEIGHTS,
+    ZERO_OPTIMIZATION_QUANTIZED_WEIGHTS_DEFAULT,
     ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE,
     ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE_DEFAULT,
     ZERO_OPTIMIZATION_REDUCE_SCATTER,
@@ -34,6 +44,11 @@ class DeepSpeedZeroConfig:
         self.cpu_offload = None
         self.elastic_checkpoint = None
         self.load_from_fp32_weights = None
+        self.quantized_gradients = None
+        self.quantized_weights = None
+        self.hierarchical_allreduce = None
+        self.hierarchical_intra_size = None
+        self.quantization_block_size = None
 
         if ZERO_OPTIMIZATION in param_dict:
             zero_config_dict = param_dict[ZERO_OPTIMIZATION]
@@ -70,6 +85,23 @@ class DeepSpeedZeroConfig:
             d, ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT, ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT)
         self.load_from_fp32_weights = get_scalar_param(
             d, ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS, ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS_DEFAULT)
+        self.quantized_gradients = get_scalar_param(
+            d, ZERO_OPTIMIZATION_QUANTIZED_GRADIENTS,
+            ZERO_OPTIMIZATION_QUANTIZED_GRADIENTS_DEFAULT)
+        self.quantized_weights = get_scalar_param(
+            d, ZERO_OPTIMIZATION_QUANTIZED_WEIGHTS,
+            ZERO_OPTIMIZATION_QUANTIZED_WEIGHTS_DEFAULT)
+        self.hierarchical_allreduce = get_scalar_param(
+            d, ZERO_OPTIMIZATION_HIERARCHICAL_ALLREDUCE,
+            ZERO_OPTIMIZATION_HIERARCHICAL_ALLREDUCE_DEFAULT)
+        self.hierarchical_intra_size = int(get_scalar_param(
+            d, ZERO_OPTIMIZATION_HIERARCHICAL_INTRA_SIZE,
+            ZERO_OPTIMIZATION_HIERARCHICAL_INTRA_SIZE_DEFAULT))
+        self.quantization_block_size = int(get_scalar_param(
+            d, ZERO_OPTIMIZATION_QUANTIZATION_BLOCK_SIZE,
+            ZERO_OPTIMIZATION_QUANTIZATION_BLOCK_SIZE_DEFAULT))
+        assert self.quantization_block_size > 0, \
+            "zero_optimization.quantization_block_size must be positive"
 
     def repr(self):
         return dict(stage=self.stage,
@@ -81,7 +113,12 @@ class DeepSpeedZeroConfig:
                     overlap_comm=self.overlap_comm,
                     cpu_offload=self.cpu_offload,
                     elastic_checkpoint=self.elastic_checkpoint,
-                    load_from_fp32_weights=self.load_from_fp32_weights)
+                    load_from_fp32_weights=self.load_from_fp32_weights,
+                    quantized_gradients=self.quantized_gradients,
+                    quantized_weights=self.quantized_weights,
+                    hierarchical_allreduce=self.hierarchical_allreduce,
+                    hierarchical_intra_size=self.hierarchical_intra_size,
+                    quantization_block_size=self.quantization_block_size)
 
     def __repr__(self):
         return str(self.repr())

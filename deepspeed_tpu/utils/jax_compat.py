@@ -8,6 +8,22 @@ one source tree runs on both.  Idempotent and cheap after the first call.
 """
 _installed = False
 
+# manual axis names of legacy shard_maps currently being traced: old jax's
+# abstract mesh has no record of them, so with_sharding_constraint callers
+# (parallel/mesh.py constrain) cannot otherwise know which axes to drop.
+# A stack because shard_maps can nest (e.g. via scan re-tracing).
+_MANUAL_AXES_STACK = []
+
+
+def current_manual_axes():
+    """Axis names manual in the innermost legacy shard_map being traced
+    (empty set on new jax, where the real shard_map reports them via the
+    abstract mesh)."""
+    out = set()
+    for names in _MANUAL_AXES_STACK:
+        out |= names
+    return out
+
 
 def ensure_compat():
     global _installed
@@ -58,9 +74,17 @@ def ensure_compat():
                 if axis_names is not None:
                     auto = frozenset(a for a in m.axis_names
                                      if a not in axis_names)
-                return _legacy_sm(f, mesh=m, in_specs=in_specs,
-                                  out_specs=out_specs, check_rep=check_rep,
-                                  auto=auto)(*args)
+                manual = set(m.axis_names) - set(auto)
+                _MANUAL_AXES_STACK.append(manual)
+                try:
+                    # the body traces inside this call, so constrain() sees
+                    # the manual axes via current_manual_axes()
+                    return _legacy_sm(f, mesh=m, in_specs=in_specs,
+                                      out_specs=out_specs,
+                                      check_rep=check_rep,
+                                      auto=auto)(*args)
+                finally:
+                    _MANUAL_AXES_STACK.pop()
             return call
         jax.shard_map = shard_map
 

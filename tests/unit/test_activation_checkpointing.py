@@ -66,7 +66,12 @@ def test_checkpoint_same_output_and_grads():
     g2 = jax.grad(loss_ckpt)(params)
     for a, b in zip(jax.tree_util.tree_leaves(g1),
                     jax.tree_util.tree_leaves(g2)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+        # remat replays the forward inside the backward pass; XLA fuses the
+        # replayed ops differently from the saved-activation build, so the
+        # two gradients agree only to f32 rounding (observed ~1e-5 relative
+        # on jax 0.4.37), not bit-exactly
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-6)
 
 
 def test_checkpoint_partition_activations_policy():

@@ -1,0 +1,92 @@
+"""Comm-volume regression guard runs as part of the suite (the
+check_no_bare_except pattern): a change that fattens a ZeRO collective
+fails tests, without a separate CI system."""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from comm_budget import (BUDGET_PATH, check_budgets,  # noqa: E402
+                         compute_volumes)
+
+
+def test_budget_table_checked_in_and_current():
+    """The repo's budget table exists and today's analytic volumes are
+    within the 10% growth tolerance of it."""
+    assert os.path.exists(BUDGET_PATH), \
+        "tools/comm_budgets.json missing; run tools/comm_budget.py --update"
+    with open(BUDGET_PATH) as f:
+        budgets = json.load(f)
+    violations = check_budgets(compute_volumes(), budgets)
+    assert not violations, violations
+
+
+def test_quantized_configs_stay_cheaper_than_dense():
+    """The budget table itself encodes the headline: qgZ gradient bytes
+    <= 2/7 of the dense fp32 exchange on the GPT-2-ish shape set, and the
+    hierarchical config's inter-group traffic is a small fraction."""
+    vols = compute_volumes()
+    dense = vols["gpt2-350m-ish/dp8/stage2/dense-bf16"]
+    qgz = vols["gpt2-350m-ish/dp8/stage2/qgz"]
+    assert qgz["grad_exchange_bytes_per_step"] * 7 <= \
+        dense["grad_exchange_bytes_per_step"] * 2
+    hier = vols["gpt2-350m-ish/dp8/stage2/qgz-hier4"]
+    assert 0 < hier["inter_bytes_per_step"] < \
+        hier["grad_exchange_bytes_per_step"] / 4
+
+
+def test_growth_detected():
+    """A >10% regression against the budget fails; <=10% passes."""
+    vols = compute_volumes()
+    name = next(iter(vols))
+    tight = {n: {k: (v if n != name else int(v / 1.2) or 1)
+                 for k, v in d.items()} for n, d in vols.items()}
+    violations = check_budgets(vols, tight)
+    assert violations and violations[0][0] == name
+    loose = {n: dict(d) for n, d in vols.items()}
+    assert check_budgets(vols, loose) == []
+
+
+def test_missing_config_is_a_violation():
+    vols = compute_volumes()
+    partial = dict(vols)
+    missing = sorted(partial)[0]
+    del partial[missing]
+    violations = check_budgets(vols, partial)
+    assert any(v[0] == missing for v in violations)
+
+
+def test_shard_dim_parity_with_mesh_heuristic():
+    """comm_accounting.zero_shard_dim must pick the same dim as the REAL
+    sharding heuristic (mesh.zero_merge_spec) — otherwise the budget table
+    models fictional collectives and the growth guard compares garbage."""
+    from jax.sharding import PartitionSpec as P
+
+    from comm_budget import GPT2ISH, MLP16
+    from deepspeed_tpu.parallel.mesh import zero_merge_spec
+    from deepspeed_tpu.runtime import comm_accounting as ca
+
+    class _Leaf:
+        def __init__(self, shape):
+            self.shape = shape
+            self.ndim = len(shape)
+
+    for name, shape in list(GPT2ISH) + list(MLP16):
+        for dp in (2, 8, 256):
+            spec = zero_merge_spec(P(), _Leaf(shape), dp)
+            expected = next((i for i, a in enumerate(spec) if a == "data"),
+                            None)
+            assert ca.zero_shard_dim(shape, dp) == expected, \
+                (name, shape, dp, spec)
+
+
+def test_tool_exits_clean_on_repo():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "comm_budget.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr

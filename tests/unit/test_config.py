@@ -99,6 +99,33 @@ def test_fp16_and_zero_parsing():
     assert config.gradient_clipping == 1.0
 
 
+def test_zero_quantized_collectives_parsing():
+    """ZeRO++-style knobs: defaults off, values round-trip, block size
+    validated."""
+    config = make_config({"train_batch_size": 8,
+                          "zero_optimization": {"stage": 2}}, world_size=1)
+    zc = config.zero_config
+    assert zc.quantized_gradients is False
+    assert zc.quantized_weights is False
+    assert zc.hierarchical_allreduce is False
+    assert zc.hierarchical_intra_size == 0
+    assert zc.quantization_block_size == 128
+
+    config = make_config({"train_batch_size": 8, "zero_optimization": {
+        "stage": 2, "quantized_gradients": True, "quantized_weights": True,
+        "hierarchical_allreduce": True, "hierarchical_intra_size": 4,
+        "quantization_block_size": 256}}, world_size=1)
+    zc = config.zero_config
+    assert zc.quantized_gradients and zc.quantized_weights
+    assert zc.hierarchical_allreduce and zc.hierarchical_intra_size == 4
+    assert zc.quantization_block_size == 256
+    assert "quantized_gradients" in zc.repr()
+
+    with pytest.raises(AssertionError):
+        make_config({"train_batch_size": 8, "zero_optimization": {
+            "stage": 2, "quantization_block_size": 0}}, world_size=1)
+
+
 def test_zero_stage3_accepted_stage4_rejected():
     """Stage 3 (param sharding) is supported as an extension beyond the
     reference snapshot; anything above is rejected."""

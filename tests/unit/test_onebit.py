@@ -196,7 +196,8 @@ def _collective_bytes(hlo_text):
         # `%...` before the op name means a get-tuple-element reference, not
         # the collective itself
         m = re.search(r"=\s*(\(?[^()=]*\)?)\s*"
-                      r"(all-reduce|all-to-all|all-gather|collective-permute)"
+                      r"(all-reduce|all-to-all|all-gather|reduce-scatter"
+                      r"|collective-permute)"
                       r"(-start)?(\.\d+)?\(", line)
         if not m or line.lstrip().startswith("ROOT %get") \
                 or "get-tuple-element(" in line:

@@ -349,10 +349,14 @@ def test_engine_save_is_atomic_on_disk(tmp_path):
 def test_kill_mid_checkpoint_never_corrupts_latest(tmp_path, kill):
     """Acceptance criterion: interrupt the write at several points; the
     previous checkpoint stays the loadable latest, bit-exact."""
+    import jax
+
     e1 = make(cfg())
     it = steps(e1, 3)
     e1.save_checkpoint(str(tmp_path))  # good tag @ step 3
-    good_params = e1.state.params
+    # host copy: the donated micro/apply jits reuse state buffers in place,
+    # so a device reference would be dead after the next training step
+    good_params = jax.device_get(e1.state.params)
 
     steps(e1, 2, it)
     chaos.arm(**kill)
@@ -369,11 +373,46 @@ def test_kill_mid_checkpoint_never_corrupts_latest(tmp_path, kill):
     tree_equal(good_params, e2.state.params)
 
 
+def test_recovery_load_discards_staged_micro(tmp_path):
+    """In-process recovery: forward() staged a micro-batch, something blew
+    up before backward(), the loop reloads a checkpoint — the stale staged
+    state must be discarded (not refuse the next forward, and never be
+    committable over the loaded state)."""
+    e = make(cfg(fp16=False))
+    it = steps(e, 2)
+    e.save_checkpoint(str(tmp_path))
+    e.forward(next(it))                  # staged; simulate a crash here
+    path, _ = e.load_checkpoint(str(tmp_path))
+    assert path.endswith("global_step2")
+    assert e._pending_state is None
+    steps(e, 2, it)                      # trains normally after recovery
+    assert e.global_steps == 4
+
+
+def test_dead_donated_state_raises_actionable_errors(tmp_path):
+    """A micro step that fails AFTER dispatch leaves donated (deleted)
+    buffers behind; forward/save must name the recovery path instead of
+    surfacing raw XLA buffer errors."""
+    import jax
+
+    e = make(cfg(fp16=False))
+    it = steps(e, 1)
+    for leaf in jax.tree_util.tree_leaves(e.state):
+        leaf.delete()                    # what a failed donated exec leaves
+    with pytest.raises(RuntimeError, match="load_checkpoint"):
+        e.forward(next(it))
+    with pytest.raises(RuntimeError, match="load_checkpoint"):
+        e.save_checkpoint(str(tmp_path))
+
+
 def test_auto_resume_falls_back_past_corrupt_tag(tmp_path):
+    import jax
+
     e = make(cfg())
     it = steps(e, 2)
     e.save_checkpoint(str(tmp_path), backend="npz")  # global_step2 (good)
-    step2_params = e.state.params
+    # host copy: device refs don't survive later steps (donated buffers)
+    step2_params = jax.device_get(e.state.params)
     steps(e, 2, it)
     e.save_checkpoint(str(tmp_path), backend="npz")  # step4, to be corrupted
     chaos.corrupt_file(str(tmp_path / "global_step4" / "model_states.npz"),

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Comm-volume regression guard.
+
+Computes the analytic bytes/step (runtime/comm_accounting.py — pure
+shape/mesh math, no devices, deterministic on CPU) for a table of canonical
+configurations and compares each against the checked-in budget in
+``tools/comm_budgets.json``.  A config whose bytes/step grew more than 10%
+over its budget FAILS: someone fattened a ZeRO collective (dropped the
+quantization, widened a dtype, added a gather) without re-justifying the
+budget.
+
+Run directly, or via tests/unit/test_comm_budget.py so regressions fail the
+suite without a separate CI system (same pattern as check_no_bare_except).
+
+  python tools/comm_budget.py            # check against the budget table
+  python tools/comm_budget.py --update   # rewrite the budget table
+
+Exit status 0 = within budget, 1 = violations (printed per config).
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from deepspeed_tpu.runtime import comm_accounting as ca  # noqa: E402
+
+BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "comm_budgets.json")
+GROWTH_TOLERANCE = 0.10
+
+# GPT-2 350M-ish decoder shapes (what bench.py trains): embeddings + 24
+# blocks of qkv/proj/mlp + layernorms.  Shapes only — no model is built.
+_H, _L, _V, _S = 1024, 24, 50304, 1024
+GPT2ISH = (
+    [("wte", (_V, _H)), ("wpe", (_S, _H))]
+    + [(f"h{i}/{name}", shape) for i in range(_L) for name, shape in [
+        ("qkv", (_H, 3 * _H)), ("attn_out", (_H, _H)),
+        ("mlp_in", (_H, 4 * _H)), ("mlp_out", (4 * _H, _H)),
+        ("ln1", (_H,)), ("ln2", (_H,)),
+    ]]
+)
+MLP16 = [("w1", (16, 16)), ("b1", (16,)), ("w2", (16, 4)), ("b2", (4,))]
+
+
+def _leaves(shapes, dp):
+    return [ca.LeafSpec(name=n, shape=s,
+                        shard_dim=ca.zero_shard_dim(s, dp))
+            for n, s in shapes]
+
+
+CONFIGS = {
+    "gpt2-350m-ish/dp8/stage2/dense-bf16": dict(
+        shapes=GPT2ISH, dp=8, quantized_gradients=False),
+    "gpt2-350m-ish/dp8/stage2/qgz": dict(
+        shapes=GPT2ISH, dp=8, quantized_gradients=True),
+    "gpt2-350m-ish/dp8/stage2/qgz-hier4": dict(
+        shapes=GPT2ISH, dp=8, quantized_gradients=True, intra_size=4),
+    "gpt2-350m-ish/dp8/stage2/qgz-qwz": dict(
+        shapes=GPT2ISH, dp=8, quantized_gradients=True,
+        quantized_weights=True),
+    "gpt2-350m-ish/dp256/stage2/qgz-hier8": dict(
+        shapes=GPT2ISH, dp=256, quantized_gradients=True, intra_size=8),
+    "mlp16/dp8/stage2/dense": dict(shapes=MLP16, dp=8,
+                                   quantized_gradients=False),
+    "mlp16/dp8/stage2/qgz": dict(shapes=MLP16, dp=8,
+                                 quantized_gradients=True),
+}
+
+
+def compute_volumes():
+    """{config name: {total/grad/param/inter bytes per step}}."""
+    out = {}
+    for name, cfg in CONFIGS.items():
+        dp = cfg["dp"]
+        report = ca.volume_report(
+            _leaves(cfg["shapes"], dp), dp,
+            gas=cfg.get("gas", 1),
+            quantized_gradients=cfg.get("quantized_gradients", False),
+            quantized_weights=cfg.get("quantized_weights", False),
+            block_size=cfg.get("block_size", 128),
+            intra_size=cfg.get("intra_size", 0),
+            param_dtype=cfg.get("param_dtype", "bfloat16"))
+        out[name] = {
+            "total_bytes_per_step": report["total_bytes_per_step"],
+            "grad_exchange_bytes_per_step":
+                report["grad_exchange_bytes_per_step"],
+            "param_gather_bytes_per_step":
+                report["param_gather_bytes_per_step"],
+            "inter_bytes_per_step": report["inter_bytes_per_step"],
+        }
+    return out
+
+
+def check_budgets(volumes, budgets, tolerance=GROWTH_TOLERANCE):
+    """Violations as (config, key, actual, budget) tuples.  A config or key
+    missing from the budget table is itself a violation — new configs must
+    check in a budget, not dodge the guard."""
+    violations = []
+    for name, vols in volumes.items():
+        if name not in budgets:
+            violations.append((name, "<missing from budget table>", None,
+                               None))
+            continue
+        for key, actual in vols.items():
+            budget = budgets[name].get(key)
+            if budget is None:
+                violations.append((name, f"{key} <missing>", actual, None))
+            elif actual > budget * (1 + tolerance):
+                violations.append((name, key, actual, budget))
+    return violations
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--update", action="store_true",
+                   help="rewrite tools/comm_budgets.json from current code")
+    p.add_argument("--budget-file", default=BUDGET_PATH)
+    args = p.parse_args(argv)
+
+    volumes = compute_volumes()
+    if args.update:
+        with open(args.budget_file, "w") as f:
+            json.dump(volumes, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.budget_file} ({len(volumes)} configs)")
+        return 0
+
+    if not os.path.exists(args.budget_file):
+        print(f"FAIL: no budget table at {args.budget_file}; run "
+              f"--update and commit it")
+        return 1
+    with open(args.budget_file) as f:
+        budgets = json.load(f)
+    violations = check_budgets(volumes, budgets)
+    if violations:
+        for name, key, actual, budget in violations:
+            if budget is None:
+                print(f"FAIL {name}: {key}")
+            else:
+                print(f"FAIL {name}: {key} = {actual} bytes/step exceeds "
+                      f"budget {budget} by "
+                      f"{100 * (actual / budget - 1):.1f}% "
+                      f"(>{100 * GROWTH_TOLERANCE:.0f}% allowed)")
+        print(f"{len(violations)} comm-budget violation(s). If the growth "
+              f"is intentional, run tools/comm_budget.py --update and "
+              f"justify the new budget in the PR.")
+        return 1
+    for name, vols in sorted(volumes.items()):
+        print(f"ok {name}: {vols['total_bytes_per_step']} bytes/step")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

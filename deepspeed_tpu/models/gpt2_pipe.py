@@ -34,6 +34,22 @@ class GPT2Embed(nn.Module):
         return x
 
 
+class GPT2LMHead(nn.Module):
+    """UNTIED LM head: its own vocab projection matrix (named wte so the
+    TP spec and tied-head checkpoints line up shape-wise). The default
+    pipeline ties the head to GPT2Embed's wte; this variant exists for
+    schedules that cannot host tied weights (zb-h1)."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = self.config
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (cfg.padded_vocab_size, cfg.n_embd), jnp.float32)
+        logits = jnp.einsum("bse,ve->bsv", x, wte.astype(x.dtype))
+        return logits[..., :cfg.vocab_size]
+
+
 class GPT2BlockLayer(nn.Module):
     config: GPT2Config
     use_moe: bool = False
@@ -74,23 +90,36 @@ def _tp_spec(params):
 
 
 def gpt2_pipeline_module(config: GPT2Config, partition_method="parameters",
-                         activation_checkpoint_interval=0):
+                         activation_checkpoint_interval=0,
+                         untied_head=False):
     """Build the LayerSpec pipeline for a GPT-2 config (TP specs included —
     with mesh model>1 this is the 3D PP x TP x DP configuration). MoE
     configs (moe_num_experts > 0) alternate dense/MoE blocks exactly like
     the monolithic GPT2Model; each MoE block's load-balance loss is sown
-    stage-locally and the PipelineEngine folds it into the objective."""
-    specs = [TiedLayerSpec("embed", GPT2Embed, config,
-                           partition_spec=_tp_spec)]
+    stage-locally and the PipelineEngine folds it into the objective.
+
+    untied_head: give the LM head its OWN embedding matrix instead of
+    tying it to the input embedding — tied weights block the zb-h1
+    pipeline schedule (deferred wgrads vs the cross-stage tied-grad
+    reduction), so zero-bubble runs use this variant."""
+    if untied_head:
+        specs = [LayerSpec(GPT2Embed, config, partition_spec=_tp_spec)]
+    else:
+        specs = [TiedLayerSpec("embed", GPT2Embed, config,
+                               partition_spec=_tp_spec)]
     for i in range(config.n_layer):
         use_moe = bool(config.moe_num_experts) \
             and i % config.moe_layer_freq == config.moe_layer_freq - 1
         specs.append(LayerSpec(GPT2BlockLayer, config, use_moe=use_moe,
                                partition_spec=_tp_spec))
     specs.append(LayerSpec(GPT2FinalNorm, config))
-    specs.append(TiedLayerSpec("embed", GPT2Embed, config,
-                               forward_fn=_tied_lm_head,
+    if untied_head:
+        specs.append(LayerSpec(GPT2LMHead, config,
                                partition_spec=_tp_spec))
+    else:
+        specs.append(TiedLayerSpec("embed", GPT2Embed, config,
+                                   forward_fn=_tied_lm_head,
+                                   partition_spec=_tp_spec))
 
     def loss_fn(logits, batch):
         return cross_entropy_loss(logits[:, :-1], batch["labels"][:, 1:],

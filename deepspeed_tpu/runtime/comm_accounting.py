@@ -287,6 +287,58 @@ def volume_report(leaves: Sequence[LeafSpec], dp: int, *,
     return report
 
 
+def pipe_p2p_collectives(
+        boundary_elems: int, micro_batches: int, *, stages: int,
+        virtual_stages: int = 1,
+        act_dtype: str = "float32",
+        grad_dtype: Optional[str] = None,
+        name: str = "pipe") -> List[Collective]:
+    """Pipeline p2p traffic of one optimizer step as budgeted collectives.
+
+    Each of the ``stages*virtual_stages - 1`` chunk boundaries moves one
+    activation (forward) and one gradient (backward) of ``boundary_elems``
+    elements per micro-batch; a p2p hop is a point-to-point copy, so the
+    sender puts the FULL payload on the wire (no ring discount). One
+    Collective per boundary per direction, honoring the dataclass
+    contract: ``bytes_per_device`` is what the single sending stage puts
+    on that edge per micro. Interleaved virtual stages multiply
+    boundaries from (S-1) to (S*v - 1): the analytic bubble win
+    (bubble_accounting) costs (v-1)*S extra boundary crossings per
+    micro — this function is what makes that trade show up in
+    comm_budgets.json instead of hiding in the schedule."""
+    chunks = stages * virtual_stages
+    grad_dtype = grad_dtype or act_dtype
+    ea, eg = DTYPE_BYTES[act_dtype], DTYPE_BYTES[grad_dtype]
+    out: List[Collective] = []
+    for edge in range(max(0, chunks - 1)):
+        out.append(Collective(
+            name=f"p2p_act:{name}:e{edge}", op="p2p", dtype=act_dtype,
+            elements=boundary_elems, axis_size=2,
+            bytes_per_device=boundary_elems * ea,
+            count_per_step=micro_batches))
+        out.append(Collective(
+            name=f"p2p_grad:{name}:e{edge}", op="p2p", dtype=grad_dtype,
+            elements=boundary_elems, axis_size=2,
+            bytes_per_device=boundary_elems * eg,
+            count_per_step=micro_batches))
+    return out
+
+
+def pipe_p2p_bytes(act_bytes_per_edge: Sequence[int],
+                   grad_bytes_per_edge: Sequence[int],
+                   micro_batches: int) -> int:
+    """Total p2p bytes per optimizer step from recorded per-boundary
+    payload sizes. Heterogeneous BOUNDARIES (e.g. a chunk that changes
+    width) are summed exactly; micro-batches are assumed shape-uniform
+    (the engine slices one batch into equal micros — a data_iter yielding
+    ragged micro shapes retraces jits anyway, and then this number is
+    representative, to be cross-checked against the engine's measured
+    bytes in pipeline_report()['p2p'])."""
+    per_micro = sum(int(b) for b in act_bytes_per_edge) \
+        + sum(int(b) for b in grad_bytes_per_edge)
+    return per_micro * int(micro_batches)
+
+
 def zero_shard_dim(shape: Sequence[int], dp: int,
                    taken: Sequence[int] = ()) -> Optional[int]:
     """The dimension mesh.zero_merge_spec would shard over 'data': the

@@ -317,6 +317,19 @@ def get_resilience_config(param_dict):
 
 def get_pipeline_config(param_dict):
     d = param_dict.get(PIPELINE, {})
+    schedule = str(d.get(PIPELINE_SCHEDULE, PIPELINE_SCHEDULE_DEFAULT)).lower()
+    from deepspeed_tpu.runtime.pipe.schedule import KNOWN_SCHEDULES
+
+    if schedule not in KNOWN_SCHEDULES:
+        raise ValueError(
+            f"pipeline.{PIPELINE_SCHEDULE} must be one of "
+            f"{list(KNOWN_SCHEDULES)}, got {schedule!r}")
+    virtual_stages = int(d.get(PIPELINE_VIRTUAL_STAGES,
+                               PIPELINE_VIRTUAL_STAGES_DEFAULT))
+    if virtual_stages < 1:
+        raise ValueError(
+            f"pipeline.{PIPELINE_VIRTUAL_STAGES} must be >= 1, "
+            f"got {virtual_stages}")
     return {
         PIPELINE_STAGES: d.get(PIPELINE_STAGES, PIPELINE_STAGES_DEFAULT),
         PIPELINE_PARTITION: d.get(PIPELINE_PARTITION, PIPELINE_PARTITION_DEFAULT),
@@ -324,6 +337,8 @@ def get_pipeline_config(param_dict):
         PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL: d.get(
             PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL,
             PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT),
+        PIPELINE_SCHEDULE: schedule,
+        PIPELINE_VIRTUAL_STAGES: virtual_stages,
     }
 
 

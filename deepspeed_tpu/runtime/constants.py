@@ -212,3 +212,7 @@ PIPELINE_SEED_LAYERS = "seed_layers"
 PIPELINE_SEED_LAYERS_DEFAULT = False
 PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL = "activation_checkpoint_interval"
 PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT = 0
+PIPELINE_SCHEDULE = "schedule"          # "1f1b" | "interleaved" | "zb-h1"
+PIPELINE_SCHEDULE_DEFAULT = "1f1b"
+PIPELINE_VIRTUAL_STAGES = "virtual_stages"  # model chunks per stage (>=1)
+PIPELINE_VIRTUAL_STAGES_DEFAULT = 1

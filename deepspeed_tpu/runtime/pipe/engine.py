@@ -29,6 +29,7 @@ fp16 dynamic loss scaling runs host-side here (the schedule is host-driven
 anyway): per-stage finite checks combine on host, overflow skips the step
 and halves the scale (reference fp16/loss_scaler.py:79-170 semantics).
 """
+import logging
 import os
 import pickle
 from collections import deque
@@ -65,13 +66,18 @@ class PipelineEngine(DeepSpeedEngine):
         import jax
 
         self.num_stages = mesh_lib.pp_size(self.mesh)
-        self.module.num_stages = self.num_stages
         self.micro_batches = self.gradient_accumulation_steps()
+        self._arm_schedule()
+        self.num_chunks = self.num_stages * self.virtual_stages
+        # the module partitions by CHUNK: with v=1 chunks == stages, with
+        # interleaving each physical stage owns v non-contiguous chunks
+        self.module.num_stages = self.num_chunks
 
         topo = PipeModelDataParallelTopology(
             num_pp=self.num_stages, num_mp=self.mp_world_size,
             num_dp=self.dp_world_size)
-        self.grid = PipelineParallelGrid(topology=topo, rank=0)
+        self.grid = PipelineParallelGrid(topology=topo, rank=0,
+                                         virtual_stages=self.virtual_stages)
 
         # one submesh per stage: mesh.devices is (pipe, data, seq, model)
         self._submeshes = []
@@ -80,9 +86,23 @@ class PipelineEngine(DeepSpeedEngine):
                 jax.sharding.Mesh(self.mesh.devices[s],
                                   ("data", "seq", "model")))
 
-        self.stage_states = None          # list[StageState], lazy
+        self.stage_states = None          # list[StageState] per CHUNK, lazy
         self._stage_shardings = None
         self._stage_jits = None
+        self._compiled_schedule = None    # CompiledSchedule, lazy
+        self._last_p2p_bytes = 0          # measured p2p volume, last batch
+        self._p2p_edge_bytes = {}         # global chunk -> (act, grad) bytes
+
+        if self.progressive_layer_drop is not None:
+            # base engine injects pld_theta into flat batches; the pipeline
+            # engine's per-stage jits never see the batch dict mid-stage
+            log_dist(
+                "PipelineEngine: progressive_layer_drop DISARMED — layers "
+                "run undropped (theta would have to thread through every "
+                "per-stage jit and re-partition stage compute; unsupported "
+                "with pipeline parallelism — use the base engine for PLD)",
+                ranks=[0], level=logging.WARNING)
+            self.progressive_layer_drop = None
         # host-side loss scaling: the schedule is host-driven, so the shared
         # host DynamicLossScaler owns the policy (hysteresis, window, floor)
         if self.fp16_enabled():
@@ -104,6 +124,69 @@ class PipelineEngine(DeepSpeedEngine):
             f"PipelineEngine: stages={self.num_stages} "
             f"micro_batches={self.micro_batches} dp={self.dp_world_size} "
             f"mp={self.mp_world_size}", ranks=[0])
+
+    def _arm_schedule(self):
+        """Resolve the requested pipeline schedule against its blockers.
+
+        Sets self.pipe_schedule (effective), self.virtual_stages, and
+        self._schedule_blockers. A blocked request falls back to plain
+        1f1b with a DISARMED warning naming every blocker (the repo's
+        armed-or-warns discipline, same as OneBitAdam/qgZ arming)."""
+        from deepspeed_tpu.runtime.constants import (PIPELINE_SCHEDULE,
+                                                     PIPELINE_VIRTUAL_STAGES)
+        from deepspeed_tpu.runtime.pipe import schedule as sched_lib
+
+        pcfg = self._config.pipeline
+        requested = pcfg[PIPELINE_SCHEDULE]
+        req_v = int(pcfg[PIPELINE_VIRTUAL_STAGES])
+        S, gas = self.num_stages, self.micro_batches
+        self.requested_schedule = requested
+        blockers = []
+
+        if requested == sched_lib.SCHEDULE_INTERLEAVED:
+            if S < 2:
+                blockers.append("pipe=1 (nothing to interleave)")
+            if req_v < 2:
+                blockers.append(f"virtual_stages={req_v} (needs >= 2)")
+            if S >= 2 and gas % S != 0:
+                blockers.append(
+                    f"gradient_accumulation_steps={gas} not divisible by "
+                    f"pipe={S} (the Megatron interleaving order requires it)")
+            if req_v >= 2:
+                why = self.module.validate_chunking(S, req_v)
+                if why:
+                    blockers.append(why)
+        elif requested == sched_lib.SCHEDULE_ZB_H1:
+            if S < 2:
+                blockers.append("pipe=1 (no bubble to fill)")
+            if self.module.has_tied_layers():
+                blockers.append(
+                    "tied layers present (deferred wgrads would interleave "
+                    "with the cross-stage tied-grad reduction)")
+            if req_v > 1:
+                log_dist(
+                    f"PipelineEngine: pipeline.virtual_stages={req_v} is "
+                    f"ignored by the zb-h1 schedule (wgrad deferral fills "
+                    f"the bubble instead of chunk interleaving)",
+                    ranks=[0], level=logging.WARNING)
+        elif req_v > 1:
+            log_dist(
+                f"PipelineEngine: pipeline.virtual_stages={req_v} has no "
+                f"effect with schedule=1f1b; set schedule=interleaved",
+                ranks=[0], level=logging.WARNING)
+
+        if blockers:
+            log_dist(
+                f"PipelineEngine: schedule '{requested}' DISARMED — "
+                f"falling back to 1f1b ({'; '.join(blockers)})",
+                ranks=[0], level=logging.WARNING)
+            self.pipe_schedule = sched_lib.SCHEDULE_1F1B
+            self.virtual_stages = 1
+        else:
+            self.pipe_schedule = requested
+            self.virtual_stages = req_v \
+                if requested == sched_lib.SCHEDULE_INTERLEAVED else 1
+        self._schedule_blockers = blockers
 
     # ------------------------------------------------------------------
     # disabled base API (reference pipe/engine.py:1090-1098)
@@ -210,13 +293,14 @@ class PipelineEngine(DeepSpeedEngine):
         full_params = jax.tree_util.tree_map(
             lambda l: np.asarray(jax.device_get(l), dtype=np.float32),
             full_params)
-        parts = self.module.partition_layers(self.num_stages)
-        logger.info(f"pipeline partition boundaries: {parts}")
+        parts = self.module.partition_layers(self.num_chunks)
+        logger.info(f"pipeline partition boundaries: {parts} "
+                    f"(chunks={self.num_chunks}, v={self.virtual_stages})")
 
         self.stage_states = []
         self._stage_shardings = []
-        for s in range(self.num_stages):
-            submesh = self._submeshes[s]
+        for s in range(self.num_chunks):
+            submesh = self._chunk_mesh(s)
             keys = self.module.stage_param_keys(s)
             p32 = {k: full_params[k] for k in keys}
             rep, zero, opt_sh = self._stage_zero_shardings(submesh, p32)
@@ -246,15 +330,23 @@ class PipelineEngine(DeepSpeedEngine):
         self._build_stage_jits()
         n = sum(self.module.num_params(st.params) for st in self.stage_states)
         log_dist(f"Pipeline state initialized: {n/1e6:.1f}M params over "
-                 f"{self.num_stages} stages", ranks=[0])
+                 f"{self.num_stages} stages x {self.virtual_stages} chunks "
+                 f"(schedule={self.pipe_schedule})", ranks=[0])
+
+    def _chunk_mesh(self, chunk):
+        """Submesh of the physical stage owning global model chunk
+        ``chunk`` (chunk q lives on stage q % pipe — grid.chunk_owner_
+        stage; with v=1 this is the identity)."""
+        return self._submeshes[self.grid.chunk_owner_stage(chunk)]
 
     def _build_stage_jits(self):
         import jax
         import jax.numpy as jnp
 
         module = self.module
-        S = self.num_stages
+        S = self.num_chunks
         gas = self.micro_batches
+        zb = self.pipe_schedule == sched_lib.SCHEDULE_ZB_H1
         loss_fn = module.loss_fn
         # does any layer sow aux losses (MoE)? decided by module.init()
         self._module_has_aux = any(l.has_losses for l in module._layers)
@@ -385,7 +477,56 @@ class PipelineEngine(DeepSpeedEngine):
                 loss, _ = loss_fn(out, batch)
                 return loss
 
-            submesh = self._submeshes[s]
+            # --- zero-bubble split backward (ZB-H1, arXiv 2401.10241) ---
+            # dgrad stays on the critical path (it unblocks the upstream
+            # stage), wgrad is deferred into bubble slots; both recompute
+            # the stage forward (per-stage remat, same as the fused
+            # backward) under the SAME rng so dropout masks agree, and the
+            # identical cotangents make dgrad+wgrad = the fused vjp.
+            def bwd_last_dgrad(params, x, rng, batch, scale,
+                               fwd_loss=fwd_loss):
+                def scaled(x_):
+                    loss, aux = fwd_loss(params, x_, rng, batch)
+                    with_aux = loss.astype(jnp.float32) + aux
+                    return with_aux * scale / gas, with_aux
+
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+                    (_, loss), gx = jax.value_and_grad(
+                        scaled, has_aux=True)(x)
+                else:
+                    _, loss = scaled(x)
+                    gx = jnp.zeros((), jnp.float32)
+                return gx, loss
+
+            def bwd_last_wgrad(params, accum, x, rng, batch, scale,
+                               fwd_loss=fwd_loss, accum_add=accum_add):
+                def scaled(p):
+                    loss, aux = fwd_loss(p, x, rng, batch)
+                    return (loss.astype(jnp.float32) + aux) * scale / gas
+
+                gp = jax.grad(scaled)(params)
+                return accum_add(accum, gp)
+
+            def bwd_mid_dgrad(params, x, rng, gy, scale, fwd_aux=fwd_aux):
+                def f(x_):
+                    y, aux = fwd_aux(params, x_, rng)
+                    return y, jnp.asarray(aux, jnp.float32)
+
+                (_, aux), vjp = jax.vjp(f, x)
+                (gx,) = vjp((gy, (scale / gas).astype(jnp.float32)))
+                return gx, aux
+
+            def bwd_mid_wgrad(params, accum, x, rng, gy, scale,
+                              fwd_aux=fwd_aux, accum_add=accum_add):
+                def f(p):
+                    y, aux = fwd_aux(p, x, rng)
+                    return y, jnp.asarray(aux, jnp.float32)
+
+                _, vjp = jax.vjp(f, params)
+                (gp,) = vjp((gy, (scale / gas).astype(jnp.float32)))
+                return accum_add(accum, gp)
+
+            submesh = self._chunk_mesh(s)
             jits = {
                 "fwd": jax.jit(fwd),
                 "bwd_last": jax.jit(bwd_last, donate_argnums=(1,))
@@ -398,6 +539,12 @@ class PipelineEngine(DeepSpeedEngine):
                 "mean_scalar": jax.jit(lambda ls: jnp.stack(ls).mean()),
                 "mesh": submesh,
             }
+            if zb:
+                jits["bwd_dgrad"] = jax.jit(bwd_last_dgrad) if is_last \
+                    else jax.jit(bwd_mid_dgrad)
+                jits["bwd_wgrad"] = (
+                    jax.jit(bwd_last_wgrad, donate_argnums=(1,)) if is_last
+                    else jax.jit(bwd_mid_wgrad, donate_argnums=(1,)))
             self._stage_jits.append(jits)
 
     # ------------------------------------------------------------------
@@ -417,12 +564,21 @@ class PipelineEngine(DeepSpeedEngine):
 
         return jax.tree_util.tree_map(put, tree)
 
-    def _transfer(self, arr, to_stage):
+    def _transfer(self, arr, to_stage, edge=None, kind=None):
         """Move an activation/grad tensor to an adjacent stage's submesh —
-        the p2p edge (reference pipe/p2p.py:31-58)."""
+        the p2p edge (reference pipe/p2p.py:31-58). ``edge``/``kind`` tag
+        the chunk boundary for the p2p volume accounting (edge q = the
+        boundary between global chunks q and q+1)."""
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        if edge is not None:
+            nbytes = int(arr.size) * arr.dtype.itemsize
+            self._last_p2p_bytes += nbytes
+            # first-seen payload per (edge, kind): the stable representative
+            # for the analytic model (micros are shape-uniform slices of one
+            # batch; see comm_accounting.pipe_p2p_bytes)
+            self._p2p_edge_bytes.setdefault(edge, {}).setdefault(kind, nbytes)
         submesh = self._submeshes[to_stage]
         spec = P(*(["data"] + [None] * (arr.ndim - 1)))
         return jax.device_put(arr, NamedSharding(submesh, spec))
@@ -446,8 +602,8 @@ class PipelineEngine(DeepSpeedEngine):
         lr = self._advance_lr()
         sq_total, all_finite = 0.0, True
         stats = []
-        for s in range(self.num_stages):
-            with jax.set_mesh(self._submeshes[s]):
+        for s in range(self.num_chunks):
+            with jax.set_mesh(self._chunk_mesh(s)):
                 stats.append(self._stage_jits[s]["sqnorm"](
                     self.stage_states[s].accum))
         for sq, finite in stats:
@@ -461,8 +617,8 @@ class PipelineEngine(DeepSpeedEngine):
             gnorm = np.sqrt(sq_total) * inv_scale
             clip = self.gradient_clipping()
             clip_factor = min(1.0, clip / (gnorm + 1e-6)) if clip else 1.0
-            for s in range(self.num_stages):
-                with jax.set_mesh(self._submeshes[s]):
+            for s in range(self.num_chunks):
+                with jax.set_mesh(self._chunk_mesh(s)):
                     self.stage_states[s] = self._stage_jits[s]["apply_step"](
                         self.stage_states[s], np.float32(lr),
                         np.float32(inv_scale), np.float32(clip_factor))
@@ -477,8 +633,8 @@ class PipelineEngine(DeepSpeedEngine):
                      f"{self._pipe_scaler.cur_scale:g}", ranks=[0])
             import jax.numpy as jnp
 
-            for s in range(self.num_stages):
-                with jax.set_mesh(self._submeshes[s]):
+            for s in range(self.num_chunks):
+                with jax.set_mesh(self._chunk_mesh(s)):
                     st = self.stage_states[s]
                     # zeros_like, NOT a*0.0: accum holds Inf/NaN here and
                     # inf*0 = NaN would poison every subsequent step
@@ -489,22 +645,24 @@ class PipelineEngine(DeepSpeedEngine):
         self.micro_steps += self.micro_batches
         self.tput_timer.stop()
         # one reduction + one transfer instead of gas scalar fetches
-        with jax.set_mesh(self._submeshes[-1]):
+        with jax.set_mesh(self._chunk_mesh(self.num_chunks - 1)):
             loss = float(jax.device_get(
                 self._stage_jits[-1]["mean_scalar"](losses)))
-        # mid-stage aux losses (MoE load balance) join the reported
+        # mid-chunk aux losses (MoE load balance) join the reported
         # objective so train_batch returns the same number regardless of
-        # stage count (the last stage's own aux is already inside `loss`)
+        # stage count (the last chunk's own aux is already inside `loss`)
         for s, auxes in enumerate(mid_auxes):
             if auxes:
-                with jax.set_mesh(self._submeshes[s]):
+                with jax.set_mesh(self._chunk_mesh(s)):
                     loss += float(jax.device_get(
                         self._stage_jits[s]["mean_scalar"](auxes)))
         self._last_loss = loss
         self._last_metrics = {
             "overflow": not all_finite,
             "grad_norm": getattr(self, "_last_grad_norm", 0.0),
-            "loss_scale": scale, "loss": loss}
+            "loss_scale": scale, "loss": loss,
+            "pipe_schedule": self.pipe_schedule,
+            "pipe_p2p_bytes_per_step": self._last_p2p_bytes}
         self._observe_step_outcome(loss=loss, overflow=not all_finite)
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps)
@@ -516,23 +674,24 @@ class PipelineEngine(DeepSpeedEngine):
 
         micros = self._collect_micros(data_iter, batch)
         self._ensure_pipe_state(micros[0])
-        S = self.num_stages
+        C = self.num_chunks
         losses = []
-        act = {}
         rng = jax.random.fold_in(self._pipe_rng, self.global_steps)
-        # forward wavefront, double-buffered per the InferenceSchedule
+        # forward wavefront over model chunks (with interleaving the
+        # activation hops back to stage 0 after each chunk group)
         for mb, micro in enumerate(micros):
             x = self._put_stage(self.module.input_fn(micro), 0)
-            for s in range(S):
-                jits = self._stage_jits[s]
-                with jax.set_mesh(self._submeshes[s]):
-                    if s == S - 1:
-                        batch_dev = self._put_stage(micro, s)
+            for q in range(C):
+                jits = self._stage_jits[q]
+                with jax.set_mesh(self._chunk_mesh(q)):
+                    if q == C - 1:
+                        batch_dev = self._put_stage(micro, self.num_stages - 1)
                         losses.append(jits["eval_loss"](
-                            self.stage_states[s].params, x, rng, batch_dev))
+                            self.stage_states[q].params, x, rng, batch_dev))
                     else:
-                        x = jits["eval_fwd"](self.stage_states[s].params, x, rng)
-                        x = self._transfer(x, s + 1)
+                        x = jits["eval_fwd"](self.stage_states[q].params, x, rng)
+                        x = self._transfer(
+                            x, self.grid.chunk_owner_stage(q + 1))
         out = float(np.mean([float(jax.device_get(l)) for l in losses]))
         if self._watchdog is not None:
             # eval between optimizer steps is progress, not a stalled step
@@ -548,114 +707,160 @@ class PipelineEngine(DeepSpeedEngine):
         assert data_iter is not None, "train_batch needs data_iter or batch"
         return [next(data_iter) for _ in range(gas)]
 
+    def _ensure_compiled_schedule(self):
+        if self._compiled_schedule is None:
+            self._compiled_schedule = sched_lib.compile_schedule(
+                self.pipe_schedule, self.micro_batches, self.num_stages,
+                self.virtual_stages)
+        return self._compiled_schedule
+
     def _exec_train_schedule(self, micros):
-        """Execute TrainSchedule instruction streams for all stages,
-        tick-aligned (the single-controller analog of reference
-        _exec_schedule, pipe/engine.py:1148-1161)."""
+        """Execute the compiled schedule's per-stage instruction streams
+        with queue semantics (the single-controller analog of reference
+        _exec_schedule, pipe/engine.py:1148-1161): stages advance round-
+        robin one instruction at a time; a Recv blocks its stage until the
+        matching Send ran. Device programs still overlap — dispatch is
+        async, ordering here is host-side only. A stream set that can
+        never unblock raises instead of hanging."""
         import jax
 
+        compiled = self._ensure_compiled_schedule()
         S = self.num_stages
-        scheds = [sched_lib.TrainSchedule(self.micro_batches, S, s)
-                  for s in range(S)]
-        streams = [list(sc.steps()) for sc in scheds]
-        nbuf = [sc.num_pipe_buffers() for sc in scheds]
+        C = self.num_chunks
+        streams = compiled.streams
+        nbuf = compiled.num_buffers
 
-        # per-stage buffer slots
-        in_act = [[None] * nbuf[s] for s in range(S)]    # fwd input (saved)
-        out_act = [[None] * nbuf[s] for s in range(S)]   # fwd output
-        in_grad = [[None] * nbuf[s] for s in range(S)]   # recv'd dL/dout
-        out_grad = [[None] * nbuf[s] for s in range(S)]  # computed dL/din
-        micro_dev = [[None] * nbuf[s] for s in range(S)] # loaded micro (0/last)
-        load_ptr = [0] * S                               # next micro to load
-        act_q = [deque() for _ in range(S)]   # edge s-1 -> s
-        grad_q = [deque() for _ in range(S)]  # edge s+1 -> s
+        # per-CHUNK buffer slots
+        in_act = [[None] * nbuf[q] for q in range(C)]    # fwd input (saved)
+        out_act = [[None] * nbuf[q] for q in range(C)]   # fwd output
+        in_grad = [[None] * nbuf[q] for q in range(C)]   # recv'd dL/dout
+        out_grad = [[None] * nbuf[q] for q in range(C)]  # computed dL/din
+        micro_dev = [[None] * nbuf[q] for q in range(C)] # loaded micro
+        act_q = [deque() for _ in range(C)]   # inbound acts per dest chunk
+        grad_q = [deque() for _ in range(C)]  # inbound grads per dest chunk
         losses = []
-        mid_auxes = [[] for _ in range(S)]    # per-micro aux, mid stages
+        mid_auxes = [[] for _ in range(C)]    # per-micro aux, mid chunks
         base_rng = jax.random.fold_in(self._pipe_rng, self.global_steps)
         micro_rngs = [jax.random.fold_in(base_rng, i)
                       for i in range(self.micro_batches)]
-        # every stage sees micro-batches in order, forward and backward both;
-        # counters recover the micro id (and hence the SAME rng at fwd and at
-        # the bwd recompute) without threading ids through buffers
-        fwd_ptr = [0] * S
-        bwd_ptr = [0] * S
+        scale = np.float32(self._pipe_scaler.cur_scale)
+        self._last_p2p_bytes = 0
 
-        n_ticks = len(streams[0])
-        for tick in range(n_ticks):
-            # sends first so same-tick recvs are satisfied (the reference's
-            # paired blocking broadcasts serialize the same way)
+        def chunk_of(cmd, s):
+            return getattr(cmd, "chunk_id", 0) * S + s
+
+        def exec_cmd(cmd, s):
+            q = chunk_of(cmd, s)
+            buf = cmd.buffer_id
+            mb = cmd.micro_id
+            jits = self._stage_jits[q]
+            st = self.stage_states[q]
+            if isinstance(cmd, sched_lib.SendActivation):
+                dest = q + 1
+                act_q[dest].append(self._transfer(
+                    out_act[q][buf], self.grid.chunk_owner_stage(dest),
+                    edge=q, kind="act"))
+                out_act[q][buf] = None
+            elif isinstance(cmd, sched_lib.SendGrad):
+                dest = q - 1
+                grad_q[dest].append(self._transfer(
+                    out_grad[q][buf], self.grid.chunk_owner_stage(dest),
+                    edge=q - 1, kind="grad"))
+                out_grad[q][buf] = None
+            elif isinstance(cmd, sched_lib.LoadMicroBatch):
+                micro = micros[mb]
+                if q == 0:
+                    in_act[q][buf] = self._put_stage(
+                        self.module.input_fn(micro), 0)
+                if q == C - 1:
+                    micro_dev[q][buf] = self._put_stage(micro, S - 1)
+            elif isinstance(cmd, sched_lib.RecvActivation):
+                in_act[q][buf] = act_q[q].popleft()
+            elif isinstance(cmd, sched_lib.RecvGrad):
+                in_grad[q][buf] = grad_q[q].popleft()
+            elif isinstance(cmd, sched_lib.ForwardPass):
+                with jax.set_mesh(self._chunk_mesh(q)):
+                    if q < C - 1:
+                        out_act[q][buf] = jits["fwd"](
+                            st.params, in_act[q][buf], micro_rngs[mb])
+                    # last chunk: loss computed in the backward (fused)
+            elif isinstance(cmd, sched_lib.BackwardPass):
+                with jax.set_mesh(self._chunk_mesh(q)):
+                    if q == C - 1:
+                        new_accum, gx, loss = jits["bwd_last"](
+                            st.params, st.accum, in_act[q][buf],
+                            micro_rngs[mb], micro_dev[q][buf], scale)
+                        losses.append(loss)
+                        micro_dev[q][buf] = None
+                    else:
+                        new_accum, gx, aux = jits["bwd_mid"](
+                            st.params, st.accum, in_act[q][buf],
+                            micro_rngs[mb], in_grad[q][buf], scale)
+                        if self._module_has_aux:
+                            mid_auxes[q].append(aux)
+                    self.stage_states[q] = st._replace(accum=new_accum)
+                    out_grad[q][buf] = gx
+                in_act[q][buf] = None
+                in_grad[q][buf] = None
+            elif isinstance(cmd, sched_lib.BackwardGradPass):
+                # zb dgrad: unblocks the upstream stage; keeps in_act and
+                # in_grad LIVE for the deferred wgrad
+                with jax.set_mesh(self._chunk_mesh(q)):
+                    if q == C - 1:
+                        gx, loss = jits["bwd_dgrad"](
+                            st.params, in_act[q][buf], micro_rngs[mb],
+                            micro_dev[q][buf], scale)
+                        losses.append(loss)
+                    else:
+                        gx, aux = jits["bwd_dgrad"](
+                            st.params, in_act[q][buf], micro_rngs[mb],
+                            in_grad[q][buf], scale)
+                        if self._module_has_aux:
+                            mid_auxes[q].append(aux)
+                    out_grad[q][buf] = gx
+            elif isinstance(cmd, sched_lib.BackwardWeightPass):
+                with jax.set_mesh(self._chunk_mesh(q)):
+                    if q == C - 1:
+                        new_accum = jits["bwd_wgrad"](
+                            st.params, st.accum, in_act[q][buf],
+                            micro_rngs[mb], micro_dev[q][buf], scale)
+                        micro_dev[q][buf] = None
+                    else:
+                        new_accum = jits["bwd_wgrad"](
+                            st.params, st.accum, in_act[q][buf],
+                            micro_rngs[mb], in_grad[q][buf], scale)
+                    self.stage_states[q] = st._replace(accum=new_accum)
+                in_act[q][buf] = None
+                in_grad[q][buf] = None
+            else:  # pragma: no cover
+                raise AssertionError(f"unknown instruction {cmd}")
+
+        pc = [0] * S
+        while True:
+            progressed, alldone = False, True
             for s in range(S):
-                for cmd in streams[s][tick]:
-                    if isinstance(cmd, sched_lib.SendActivation):
-                        act_q[s + 1].append(
-                            self._transfer(out_act[s][cmd.buffer_id], s + 1))
-                    elif isinstance(cmd, sched_lib.SendGrad):
-                        grad_q[s - 1].append(
-                            self._transfer(out_grad[s][cmd.buffer_id], s - 1))
-            for s in range(S):
-                jits = self._stage_jits[s]
-                st = self.stage_states[s]
-                for cmd in streams[s][tick]:
-                    buf = getattr(cmd, "buffer_id", None)
-                    if isinstance(cmd, sched_lib.SendActivation) or \
-                            isinstance(cmd, sched_lib.SendGrad):
-                        continue
-                    if isinstance(cmd, sched_lib.LoadMicroBatch):
-                        micro = micros[load_ptr[s]]
-                        load_ptr[s] += 1
-                        if s == 0:
-                            in_act[s][buf] = self._put_stage(
-                                self.module.input_fn(micro), 0)
-                        if s == S - 1:
-                            micro_dev[s][buf] = self._put_stage(micro, s)
-                    elif isinstance(cmd, sched_lib.RecvActivation):
-                        in_act[s][buf] = act_q[s].popleft()
-                    elif isinstance(cmd, sched_lib.RecvGrad):
-                        in_grad[s][buf] = grad_q[s].popleft()
-                    elif isinstance(cmd, sched_lib.ForwardPass):
-                        rng = micro_rngs[fwd_ptr[s]]
-                        fwd_ptr[s] += 1
-                        with jax.set_mesh(self._submeshes[s]):
-                            if s < S - 1:
-                                out_act[s][buf] = jits["fwd"](
-                                    st.params, in_act[s][buf], rng)
-                            # last stage: loss computed in backward (fused)
-                    elif isinstance(cmd, sched_lib.BackwardPass):
-                        rng = micro_rngs[bwd_ptr[s]]
-                        bwd_ptr[s] += 1
-                        with jax.set_mesh(self._submeshes[s]):
-                            if s == S - 1:
-                                new_accum, gx, loss = jits["bwd_last"](
-                                    st.params, st.accum, in_act[s][buf], rng,
-                                    micro_dev[s][buf],
-                                    np.float32(self._pipe_scaler.cur_scale))
-                                losses.append(loss)
-                            else:
-                                new_accum, gx, aux = jits["bwd_mid"](
-                                    st.params, st.accum, in_act[s][buf], rng,
-                                    in_grad[s][buf],
-                                    np.float32(self._pipe_scaler.cur_scale))
-                                if self._module_has_aux:
-                                    mid_auxes[s].append(aux)
-                            self.stage_states[s] = st._replace(
-                                accum=new_accum)
-                            st = self.stage_states[s]
-                            out_grad[s][buf] = gx
-                        # free consumed buffers
-                        in_grad[s][buf] = None
-                    elif isinstance(cmd, sched_lib.ReduceTiedGrads):
-                        # every stage's stream emits this at the last tick;
-                        # the reduction is global, run it exactly once
-                        if s == 0:
-                            self._reduce_tied_grads()
-                        st = self.stage_states[s]
-                    elif isinstance(cmd, (sched_lib.ReduceGrads,
-                                          sched_lib.OptimizerStep)):
-                        # ReduceGrads: psum already inside backward jits;
-                        # OptimizerStep: host-coordinated in train_batch
-                        pass
-                    else:  # pragma: no cover
-                        raise AssertionError(f"unknown instruction {cmd}")
+                if pc[s] >= len(streams[s]):
+                    continue
+                alldone = False
+                cmd = streams[s][pc[s]]
+                if isinstance(cmd, sched_lib.RecvActivation) and \
+                        not act_q[chunk_of(cmd, s)]:
+                    continue                    # blocked on the producer
+                if isinstance(cmd, sched_lib.RecvGrad) and \
+                        not grad_q[chunk_of(cmd, s)]:
+                    continue
+                exec_cmd(cmd, s)
+                pc[s] += 1
+                progressed = True
+            if alldone:
+                break
+            if not progressed:  # pragma: no cover - compiler-verified
+                blocked = [s for s in range(S) if pc[s] < len(streams[s])]
+                raise RuntimeError(
+                    f"pipeline schedule '{compiled.name}' deadlocked; "
+                    f"stages {blocked} blocked at "
+                    f"{[streams[s][pc[s]] for s in blocked]}")
+        self._reduce_tied_grads()
         return losses, mid_auxes
 
     def _reduce_tied_grads(self):
@@ -665,7 +870,7 @@ class PipelineEngine(DeepSpeedEngine):
         target submesh) and sum inside a jitted add — no host round-trip."""
         import jax
 
-        groups = self.module.tied_groups(self.num_stages)
+        groups = self.module.tied_groups(self.num_chunks)
         for key, stages in groups.items():
             pkey = f"tied_{key}"
             # snapshot pre-reduction accums: summing in place would make
@@ -673,7 +878,7 @@ class PipelineEngine(DeepSpeedEngine):
             originals = {s: self.stage_states[s].accum[pkey] for s in stages}
             for target in stages:
                 total = originals[target]
-                with jax.set_mesh(self._submeshes[target]):
+                with jax.set_mesh(self._chunk_mesh(target)):
                     for s in stages:
                         if s == target:
                             continue
@@ -686,6 +891,44 @@ class PipelineEngine(DeepSpeedEngine):
                 accum[pkey] = total
                 self.stage_states[target] = \
                     self.stage_states[target]._replace(accum=accum)
+
+    # ------------------------------------------------------------------
+    # analytic schedule/bubble reporting
+    # ------------------------------------------------------------------
+    def pipeline_report(self, costs=None):
+        """Analytic pipeline execution report for the ACTIVE schedule: the
+        tick simulation's per-stage idle fractions, aggregate bubble
+        fraction, peak live activation buffers (bubble_accounting), the
+        1f1b baseline for comparison, and the p2p transfer volume
+        (measured bytes from the last train_batch; per-boundary payloads
+        once one batch has run). Deterministic on CPU — no device work."""
+        from deepspeed_tpu.runtime import comm_accounting as ca
+        from deepspeed_tpu.runtime.pipe import bubble_accounting as ba
+
+        compiled = self._ensure_compiled_schedule()
+        report = ba.simulate(compiled, costs)
+        report["requested_schedule"] = self.requested_schedule
+        report["schedule_blockers"] = list(self._schedule_blockers)
+        if self.pipe_schedule != sched_lib.SCHEDULE_1F1B:
+            base = ba.bubble_report(
+                sched_lib.SCHEDULE_1F1B, self.micro_batches,
+                self.num_stages, costs=costs)
+            report["baseline_1f1b_bubble_fraction"] = \
+                base["bubble_fraction"]
+        p2p = {"measured_bytes_per_step": self._last_p2p_bytes or None}
+        if self._p2p_edge_bytes:
+            # model the recorded per-boundary payloads as budgeted
+            # collectives (comm_accounting idiom; joins comm_budgets.json
+            # via tools/comm_budget.py's canonical configs)
+            acts = [b.get("act", 0) for _, b in
+                    sorted(self._p2p_edge_bytes.items())]
+            grads = [b.get("grad", 0) for _, b in
+                     sorted(self._p2p_edge_bytes.items())]
+            p2p["analytic_bytes_per_step"] = ca.pipe_p2p_bytes(
+                act_bytes_per_edge=acts, grad_bytes_per_edge=grads,
+                micro_batches=self.micro_batches)
+        report["p2p"] = p2p
+        return report
 
     # ------------------------------------------------------------------
     # checkpointing (pipeline layout: per-stage state files)
@@ -723,8 +966,8 @@ class PipelineEngine(DeepSpeedEngine):
         import jax
         import jax.numpy as jnp
 
-        for s in range(self.num_stages):
-            with jax.set_mesh(self._submeshes[s]):
+        for s in range(self.num_chunks):
+            with jax.set_mesh(self._chunk_mesh(s)):
                 st = self.stage_states[s]
                 poisoned = jax.tree_util.tree_map(
                     lambda a: jnp.full_like(a, jnp.nan), st.accum)
@@ -779,7 +1022,9 @@ class PipelineEngine(DeepSpeedEngine):
             "cur_scale": self._pipe_scaler.cur_scale,
             "scaler_state": self._pipe_scaler.__dict__.copy(),
             "num_stages": self.num_stages,
-            "partition": self.module.partition_layers(self.num_stages),
+            "virtual_stages": self.virtual_stages,
+            "schedule": self.pipe_schedule,
+            "partition": self.module.partition_layers(self.num_chunks),
             "layer_keys": sorted(layer_keys),
             "format": "layer-granular",
             "lr_scheduler": self.lr_scheduler.state_dict()

@@ -30,11 +30,15 @@ class LayerSpec:
     and the stage submeshes honor it."""
 
     def __init__(self, typename, *module_args, partition_spec=None,
-                 **module_kwargs):
+                 forward_fn=None, **module_kwargs):
         self.typename = typename
         self.module_args = module_args
         self.module_kwargs = module_kwargs
         self.partition_spec = partition_spec
+        # optional custom apply (module, params, x) -> y, same contract as
+        # TiedLayerSpec.forward_fn (e.g. an untied LM head reusing the
+        # embedding module's matmul without sharing its params)
+        self.forward_fn = forward_fn
 
     def build(self, log=False):
         if log:
@@ -185,6 +189,7 @@ class PipelineModule:
                 layer.is_tied_owner = tied_owner[spec.key] == i
             elif isinstance(spec, LayerSpec):
                 layer = _Layer(spec.build(), i, f"layer_{i:02d}",
+                               spec.forward_fn,
                                spec_fn=spec.partition_spec)
             else:
                 layer = _Layer(spec, i,
@@ -338,6 +343,25 @@ class PipelineModule:
             raise KeyError(f"unknown partition method {self.partition_method}")
         self._parts = parts
         return parts
+
+    def validate_chunking(self, stages, virtual_stages):
+        """Blocker string (for the engine's DISARMED warning) if this layer
+        list cannot be split into ``stages * virtual_stages`` interleaved
+        chunks, else None. Chunk partitioning reuses partition_layers with
+        the chunk count as the stage count, so every chunk must be
+        non-empty and the layer count must divide evenly — a ragged split
+        would put unequal work on the same device's chunks and break the
+        ~1/v bubble model."""
+        chunks = stages * virtual_stages
+        n = len(self._layers)
+        if n % chunks != 0:
+            return (f"layer count {n} is not divisible by pipe x "
+                    f"virtual_stages = {stages} x {virtual_stages}")
+        return None
+
+    def has_tied_layers(self):
+        """True when any layer shares params via TiedLayerSpec."""
+        return any(l.tied_key is not None for l in self._layers)
 
     # ------------------------------------------------------------------
     # introspection used by the engine

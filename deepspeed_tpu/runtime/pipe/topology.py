@@ -112,13 +112,18 @@ class PipelineParallelGrid:
     """
 
     def __init__(self, topology=None, process_group=None, rank=0,
-                 world_size=None):
+                 world_size=None, virtual_stages=1):
         if topology is None:
             assert world_size is not None
             topology = PipeDataParallelTopology(num_pp=1, num_dp=world_size)
         self._topo = topology
         self.global_rank = rank
         self.world_size = topology.world_size()
+        # interleaved virtual stages: each physical stage owns
+        # ``virtual_stages`` non-contiguous model chunks (Megatron
+        # interleaving); chunk q lives on stage q % pipe and is that
+        # stage's local chunk q // pipe
+        self.virtual_stages = max(1, int(virtual_stages))
 
         coord = self._topo.get_coord(rank)
         self.stage_id = getattr(coord, "pipe", 0)
@@ -167,6 +172,17 @@ class PipelineParallelGrid:
         return {"pipe": self.pipe_parallel_size,
                 "data": self.data_parallel_size,
                 "model": self.model_parallel_size}
+
+    # --- virtual-stage (model chunk) coordinates ---------------------------
+    @property
+    def num_model_chunks(self):
+        return self.pipe_parallel_size * self.virtual_stages
+
+    def chunk_owner_stage(self, chunk):
+        """Physical stage holding global model chunk ``chunk`` (that
+        stage's local chunk index is chunk // pipe)."""
+        assert 0 <= chunk < self.num_model_chunks, f"chunk {chunk} invalid"
+        return chunk % self.pipe_parallel_size
 
     # --- stage predicates -------------------------------------------------
     def is_first_stage(self):

@@ -295,6 +295,198 @@ def test_pipe_checkpoint_restage(tmp_path):
         np.testing.assert_allclose(l1, l2, rtol=2e-4)
 
 
+# ---------------------------------------------------------------------------
+# interleaved virtual stages + zero-bubble zb-h1 (ISSUE 3)
+# ---------------------------------------------------------------------------
+
+def _train_layers(pipe, dp, n_layers, steps=5, tied=False, seed=0,
+                  extra=None):
+    """_train with an explicit layer count (n_layers Dense + 1 Head), for
+    schedules with chunk-divisibility constraints."""
+    specs, loss_fn, input_fn = make_stack_specs(HIDDEN, n_layers,
+                                                tied_head=tied)
+    module = PipelineModule(specs, loss_fn=loss_fn, input_fn=input_fn,
+                            partition_method="uniform")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=module, config_params=_config(dp, pipe, extra))
+    data = random_dataloader(HIDDEN, 64, MICRO * dp, seed=seed)
+    losses = [engine.train_batch(data_iter=data) for _ in range(steps)]
+    return engine, losses
+
+
+def test_pipe_interleaved_matches_1f1b():
+    """Interleaved virtual stages reorder execution, not math: the loss
+    trajectory must match plain 1f1b (acceptance: parity within fp
+    tolerance on the CPU mesh)."""
+    extra = {"pipeline": {"schedule": "interleaved", "virtual_stages": 2}}
+    _, base = _train_layers(pipe=2, dp=2, n_layers=7)
+    engine, inter = _train_layers(pipe=2, dp=2, n_layers=7, extra=extra)
+    assert engine.pipe_schedule == "interleaved"
+    assert engine.virtual_stages == 2
+    assert engine.num_chunks == 4
+    np.testing.assert_allclose(base, inter, rtol=2e-4)
+
+
+def test_pipe_interleaved_4stage_matches():
+    # gas must be divisible by pipe=4 for the Megatron interleave order
+    gas4 = {"gradient_accumulation_steps": 4,
+            "train_batch_size": MICRO * 4 * 2}
+    extra = dict(gas4,
+                 pipeline={"schedule": "interleaved", "virtual_stages": 2})
+    _, base = _train_layers(pipe=4, dp=2, n_layers=7, steps=4, extra=gas4)
+    engine, inter = _train_layers(pipe=4, dp=2, n_layers=7, steps=4,
+                                  extra=extra)
+    assert engine.pipe_schedule == "interleaved"
+    np.testing.assert_allclose(base, inter, rtol=2e-4)
+
+
+def test_pipe_zb_h1_matches_1f1b():
+    """ZB-H1's split dgrad/wgrad backward must sum to the fused vjp: same
+    trajectory as 1f1b."""
+    _, base = _train_layers(pipe=4, dp=2, n_layers=7)
+    engine, zb = _train_layers(pipe=4, dp=2, n_layers=7,
+                               extra={"pipeline": {"schedule": "zb-h1"}})
+    assert engine.pipe_schedule == "zb-h1"
+    np.testing.assert_allclose(base, zb, rtol=2e-4)
+
+
+def test_pipe_zb_h1_with_clipping_matches():
+    """Gradient clipping reads the accumulated norm AFTER all deferred
+    wgrads landed — a dropped/double wgrad would shift clip_factor and
+    diverge."""
+    extra_c = {"gradient_clipping": 0.05}
+    _, base = _train_layers(pipe=2, dp=2, n_layers=7, extra=extra_c)
+    _, zb = _train_layers(
+        pipe=2, dp=2, n_layers=7,
+        extra=dict(extra_c, pipeline={"schedule": "zb-h1"}))
+    np.testing.assert_allclose(base, zb, rtol=5e-3)
+
+
+def test_pipe_interleaved_bf16():
+    extra = {"pipeline": {"schedule": "interleaved", "virtual_stages": 2},
+             "bf16": {"enabled": True}}
+    engine, losses = _train_layers(pipe=2, dp=2, n_layers=7, steps=6,
+                                   extra=extra)
+    assert engine.pipe_schedule == "interleaved"
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 1.05
+
+
+def test_pipe_interleaved_eval_batch():
+    extra = {"pipeline": {"schedule": "interleaved", "virtual_stages": 2}}
+    engine, _ = _train_layers(pipe=2, dp=2, n_layers=7, steps=2,
+                              extra=extra)
+    data = random_dataloader(HIDDEN, 64, MICRO * 2, seed=5)
+    assert np.isfinite(engine.eval_batch(data_iter=data))
+
+
+def test_pipe_interleaved_checkpoint_restage(tmp_path):
+    """Layer-granular checkpoints are schedule-independent: save from an
+    interleaved v=2 engine, load into a plain 1f1b engine at a different
+    stage count, continue bit-compatibly."""
+    e1, _ = _train_layers(
+        pipe=2, dp=2, n_layers=7, steps=3,
+        extra={"pipeline": {"schedule": "interleaved", "virtual_stages": 2}})
+    e1.save_checkpoint(str(tmp_path), tag="iv")
+    e2, _ = _train_layers(pipe=4, dp=2, n_layers=7, steps=1, seed=9)
+    path, _ = e2.load_checkpoint(str(tmp_path), tag="iv")
+    assert path is not None
+    d1 = random_dataloader(HIDDEN, 64, MICRO * 2, seed=77)
+    d2 = random_dataloader(HIDDEN, 64, MICRO * 2, seed=77)
+    for _ in range(2):
+        l1 = float(jax.device_get(e1.train_batch(data_iter=d1)))
+        l2 = float(jax.device_get(e2.train_batch(data_iter=d2)))
+        np.testing.assert_allclose(l1, l2, rtol=2e-4)
+
+
+def _caplog_disarmed(caplog):
+    return [r.message for r in caplog.records if "DISARMED" in r.message]
+
+
+def test_pipe_interleaved_fallback_warns(caplog):
+    """A blocked interleaved request must fall back to 1f1b LOUDLY, naming
+    the blocker (8 layers % (2 stages x 3 chunks) != 0)."""
+    import logging
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    extra = {"pipeline": {"schedule": "interleaved", "virtual_stages": 3}}
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            engine, losses = _train_layers(pipe=2, dp=2, n_layers=7,
+                                           steps=2, extra=extra)
+    finally:
+        ds_logger.propagate = False
+    assert engine.pipe_schedule == "1f1b"
+    assert engine.virtual_stages == 1
+    msgs = _caplog_disarmed(caplog)
+    assert msgs and "divisible" in msgs[0]
+    assert all(np.isfinite(losses))
+
+
+def test_pipe_interleaved_gas_fallback_warns(caplog):
+    """gas not divisible by pipe blocks the Megatron interleave order."""
+    import logging
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    # gas=2, pipe=4 (also layer-divisibility holds: 8 % 8 == 0)
+    extra = {"pipeline": {"schedule": "interleaved", "virtual_stages": 2}}
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            engine, _ = _train_layers(pipe=4, dp=1, n_layers=15, steps=1,
+                                      extra=dict(
+                                          extra,
+                                          gradient_accumulation_steps=2,
+                                          train_batch_size=MICRO * 2))
+    finally:
+        ds_logger.propagate = False
+    assert engine.pipe_schedule == "1f1b"
+    msgs = _caplog_disarmed(caplog)
+    assert msgs and "gradient_accumulation_steps" in msgs[0]
+
+
+def test_pipe_zb_h1_tied_fallback_warns(caplog):
+    """Tied weights block zb-h1; the fallback names them."""
+    import logging
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            engine, losses = _train_layers(
+                pipe=2, dp=2, n_layers=6, steps=3, tied=True,
+                extra={"pipeline": {"schedule": "zb-h1"}})
+    finally:
+        ds_logger.propagate = False
+    assert engine.pipe_schedule == "1f1b"
+    msgs = _caplog_disarmed(caplog)
+    assert msgs and "tied" in msgs[0]
+    # the fallback still trains correctly
+    assert losses[-1] < losses[0] * 1.1
+
+
+def test_pipeline_report():
+    """engine.pipeline_report(): analytic bubble + measured p2p volume."""
+    extra = {"pipeline": {"schedule": "interleaved", "virtual_stages": 2}}
+    engine, _ = _train_layers(pipe=2, dp=2, n_layers=7, steps=2,
+                              extra=extra)
+    rep = engine.pipeline_report()
+    assert rep["schedule"] == "interleaved"
+    assert rep["bubble_fraction"] < rep["baseline_1f1b_bubble_fraction"]
+    assert rep["schedule_blockers"] == []
+    assert len(rep["idle_fraction"]) == 2
+    p2p = rep["p2p"]
+    assert p2p["measured_bytes_per_step"] > 0
+    # analytic model from recorded boundary payloads == measured bytes
+    assert p2p["analytic_bytes_per_step"] == p2p["measured_bytes_per_step"]
+    assert engine._last_metrics["pipe_p2p_bytes_per_step"] == \
+        p2p["measured_bytes_per_step"]
+
+
 def test_pipe_checkpoint_restage_tied(tmp_path):
     """Restage with tied embedding/head: the shared 'tied_*' weight crosses
     stage boundaries differently at pp=1 vs pp=3."""

@@ -125,6 +125,70 @@ def test_remat_grads_match():
                                    atol=1e-7)
 
 
+def test_layerspec_forward_fn():
+    """LayerSpec.forward_fn: custom apply WITHOUT weight tying (the
+    TiedLayerSpec contract, now on plain layers too — e.g. an untied LM
+    head)."""
+    import flax.linen as nn
+
+    class Lin(nn.Module):
+        feats: int = 8
+
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(self.feats, name="lin")(x)
+
+    calls = []
+
+    def doubled(module, params, x):
+        calls.append(type(module).__name__)
+        return module.apply({"params": params}, x) * 2.0
+
+    specs = [LayerSpec(Lin), LayerSpec(Lin, forward_fn=doubled)]
+    module = PipelineModule(specs, loss_fn=lambda o, b: (o.sum(), {}))
+    batch = {"x": np.ones((2, 8), np.float32)}
+    params = module.init(jax.random.PRNGKey(0), batch)
+    assert sorted(params) == ["layer_00", "layer_01"]  # NOT tied
+    base = module._layers[1].obj.apply(
+        {"params": params["layer_01"]},
+        module._layers[0].obj.apply({"params": params["layer_00"]},
+                                    batch["x"]))
+    out = module.forward_full(params, batch, jax.random.PRNGKey(1),
+                              train=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base) * 2.0,
+                               rtol=1e-6)
+    assert "Lin" in calls
+
+
+def test_validate_chunking_and_tied_introspection():
+    module, _, _ = _build(n_layers=7)           # 8 layers, untied
+    assert module.validate_chunking(2, 2) is None
+    why = module.validate_chunking(2, 3)
+    assert "divisible" in why and "8" in why
+    assert not module.has_tied_layers()
+    tied_mod, _, _ = _build(n_layers=3, tied=True)
+    assert tied_mod.has_tied_layers()
+
+
+def test_gpt2_untied_head_matches_tied_shapes():
+    """The untied GPT-2 head owns its own wte with the tied head's shape
+    (zb-h1 uses this variant)."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline_module
+
+    cfg = GPT2Config(vocab_size=64, n_positions=16, n_embd=8, n_layer=2,
+                     n_head=2, dtype=jnp.float32)
+    tied = gpt2_pipeline_module(cfg)
+    untied = gpt2_pipeline_module(cfg, untied_head=True)
+    assert tied.has_tied_layers() and not untied.has_tied_layers()
+    batch = {"input_ids": np.zeros((2, 16), np.int64),
+             "labels": np.zeros((2, 16), np.int64)}
+    pt = tied.init(jax.random.PRNGKey(0), batch)
+    pu = untied.init(jax.random.PRNGKey(0), batch)
+    head_key = f"layer_{len(untied._layers) - 1:02d}"
+    assert pu[head_key]["wte"].shape == pt["tied_embed"]["wte"].shape
+
+
 def test_layerspec_repr():
     spec = LayerSpec(dict)
     assert "dict" in repr(spec)

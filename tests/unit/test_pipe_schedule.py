@@ -1,11 +1,13 @@
 """Schedule instruction-stream tests — reference tests/unit/test_pipe_schedule.py
-pattern plus a cross-stage dataflow simulator."""
+pattern plus a cross-stage dataflow simulator, and the compiled-schedule
+invariant suite (1f1b / interleaved virtual stages / zb-h1)."""
 import pytest
 
 from deepspeed_tpu.runtime.pipe.schedule import (
-    BackwardPass, DataParallelSchedule, ForwardPass, InferenceSchedule,
-    LoadMicroBatch, OptimizerStep, RecvActivation, RecvGrad, ReduceGrads,
-    ReduceTiedGrads, SendActivation, SendGrad, TrainSchedule)
+    BackwardGradPass, BackwardPass, BackwardWeightPass, DataParallelSchedule,
+    ForwardPass, InferenceSchedule, LoadMicroBatch, OptimizerStep,
+    RecvActivation, RecvGrad, ReduceGrads, ReduceTiedGrads, SendActivation,
+    SendGrad, TrainSchedule, compile_schedule)
 
 
 def _flat(sched):
@@ -164,3 +166,143 @@ def test_schedule_properties():
     assert sched.num_micro_batches == 4
     assert not sched.is_first_stage
     assert not sched.is_last_stage
+
+
+# ---------------------------------------------------------------------------
+# compiled-schedule invariants (1f1b / interleaved / zb-h1), parametrized
+# over pipe x gas x v — the engine executes exactly these streams
+# ---------------------------------------------------------------------------
+
+GRID = [
+    ("1f1b", 2, 4, 1), ("1f1b", 4, 8, 1), ("1f1b", 1, 4, 1),
+    ("1f1b", 3, 5, 1),
+    ("interleaved", 2, 4, 2), ("interleaved", 4, 8, 2),
+    ("interleaved", 2, 8, 3), ("interleaved", 4, 4, 4),
+    ("zb-h1", 2, 4, 1), ("zb-h1", 4, 8, 1), ("zb-h1", 3, 6, 1),
+]
+
+
+def _replay(compiled):
+    """Replay streams with engine queue semantics; returns per-chunk
+    counters. Asserts buffer bounds, liveness, and dependency order."""
+    S, C, M = compiled.stages, compiled.num_chunks, compiled.micro_batches
+    streams = [list(st) for st in compiled.streams]
+    pc = [0] * S
+    act_q = {q: [] for q in range(C)}
+    grad_q = {q: [] for q in range(C)}
+    fwd = [{} for _ in range(C)]     # chunk -> micro -> buffer
+    bwd = [[] for _ in range(C)]
+    wgrads = [[] for _ in range(C)]
+    live = [{} for _ in range(C)]    # chunk -> buffer -> micro
+
+    def chunk(cmd, s):
+        return getattr(cmd, "chunk_id", 0) * S + s
+
+    while any(pc[s] < len(streams[s]) for s in range(S)):
+        progressed = False
+        for s in range(S):
+            if pc[s] >= len(streams[s]):
+                continue
+            cmd = streams[s][pc[s]]
+            q = chunk(cmd, s)
+            if isinstance(cmd, RecvActivation) and not act_q[q]:
+                continue
+            if isinstance(cmd, RecvGrad) and not grad_q[q]:
+                continue
+            buf = getattr(cmd, "buffer_id", None)
+            if buf is not None:
+                assert 0 <= buf < compiled.num_buffers[q], \
+                    f"buffer {buf} out of bounds for chunk {q}"
+            if isinstance(cmd, (RecvActivation, LoadMicroBatch)):
+                if isinstance(cmd, RecvActivation):
+                    m = act_q[q].pop(0)
+                    assert m == cmd.micro_id
+                    # a slot must be free when (re)occupied
+                    assert live[q].get(buf) is None or \
+                        live[q][buf] == cmd.micro_id, \
+                        f"chunk {q} buffer {buf} overwritten while live"
+                live[q][buf] = cmd.micro_id
+            elif isinstance(cmd, RecvGrad):
+                m = grad_q[q].pop(0)
+                assert m == cmd.micro_id
+                assert live[q].get(buf) == cmd.micro_id
+            elif isinstance(cmd, SendActivation):
+                act_q[q + 1].append(cmd.micro_id)
+            elif isinstance(cmd, SendGrad):
+                grad_q[q - 1].append(cmd.micro_id)
+            elif isinstance(cmd, ForwardPass):
+                assert cmd.micro_id not in fwd[q], "double forward"
+                assert live[q].get(buf) == cmd.micro_id
+                fwd[q][cmd.micro_id] = buf
+            elif isinstance(cmd, (BackwardPass, BackwardGradPass)):
+                assert cmd.micro_id in fwd[q], "backward before forward"
+                assert fwd[q][cmd.micro_id] == buf, \
+                    "backward uses a different buffer than its forward"
+                bwd[q].append(cmd.micro_id)
+                if isinstance(cmd, BackwardPass):
+                    live[q][buf] = None
+            elif isinstance(cmd, BackwardWeightPass):
+                assert cmd.micro_id in bwd[q], "wgrad before dgrad"
+                assert fwd[q][cmd.micro_id] == buf
+                wgrads[q].append(cmd.micro_id)
+                live[q][buf] = None
+            pc[s] += 1
+            progressed = True
+        assert progressed, "compiled schedule deadlocked in replay"
+    assert all(not v for v in act_q.values()), "undrained activation queue"
+    assert all(not v for v in grad_q.values()), "undrained grad queue"
+    return fwd, bwd, wgrads
+
+
+@pytest.mark.parametrize("name,stages,micros,v", GRID)
+def test_compiled_schedule_invariants(name, stages, micros, v):
+    """Every micro forwards exactly once and backwards exactly once per
+    chunk; buffers stay in bounds and are never clobbered while live; the
+    queue replay never deadlocks; zb splits into dgrad+wgrad pairs."""
+    if name == "zb-h1" and stages < 2:
+        pytest.skip("zb-h1 needs pipe >= 2")
+    compiled = compile_schedule(name, micros, stages, v)
+    assert compiled.num_chunks == stages * v
+    fwd, bwd, wgrads = _replay(compiled)
+    for q in range(compiled.num_chunks):
+        assert sorted(fwd[q]) == list(range(micros))
+        assert sorted(bwd[q]) == list(range(micros))
+        if name == "zb-h1":
+            assert sorted(wgrads[q]) == list(range(micros))
+        else:
+            assert wgrads[q] == []
+
+
+@pytest.mark.parametrize("stages,micros", [(2, 4), (4, 8)])
+def test_compiled_1f1b_matches_trainschedule_op_order(stages, micros):
+    """The compiled 1f1b must execute the same per-stage compute-op
+    sequence as the legacy TrainSchedule generator (same math, same accum
+    order -> identical losses)."""
+    for s in range(stages):
+        legacy = [type(c).__name__ for step in
+                  TrainSchedule(micros, stages, s).steps() for c in step
+                  if isinstance(c, (ForwardPass, BackwardPass))]
+        compiled = compile_schedule("1f1b", micros, stages)
+        new = [type(c).__name__ for c in compiled.streams[s]
+               if isinstance(c, (ForwardPass, BackwardPass))]
+        assert new == legacy
+
+
+def test_interleaved_requires_divisible_micros():
+    with pytest.raises(AssertionError):
+        compile_schedule("interleaved", 5, 2, 2)
+
+
+def test_unknown_schedule_raises():
+    with pytest.raises(KeyError):
+        compile_schedule("gpipe", 4, 2)
+
+
+def test_interleaved_chunk_ids_cover_all_chunks():
+    compiled = compile_schedule("interleaved", 4, 2, 2)
+    seen = set()
+    for s, stream in enumerate(compiled.streams):
+        for cmd in stream:
+            if isinstance(cmd, ForwardPass):
+                seen.add(cmd.chunk_id * 2 + s)
+    assert seen == {0, 1, 2, 3}

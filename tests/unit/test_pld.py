@@ -52,6 +52,42 @@ class PLDModel:
         return loss, {"loss": loss}
 
 
+def test_pipeline_engine_disarms_pld(caplog):
+    """PLD is armed on the base engine (test above); the PipelineEngine
+    cannot thread theta through its per-stage jits, so asking for both
+    must warn DISARMED (armed-or-warns convention) and train undropped
+    instead of silently ignoring the knob."""
+    import logging
+
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+    from tests.unit.simple_model import make_stack_specs, random_dataloader
+
+    specs, loss_fn, input_fn = make_stack_specs(8, 3)
+    module = PipelineModule(specs, loss_fn=loss_fn, input_fn=input_fn,
+                            partition_method="uniform")
+    cfg = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 2,
+           "gradient_accumulation_steps": 2,
+           "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+           "progressive_layer_drop": {"enabled": True, "theta": 0.5},
+           "mesh": {"pipe": 2, "data": 2, "allow_partial": True},
+           "steps_per_print": 100}
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            engine, _, _, _ = deepspeed_tpu.initialize(model=module,
+                                                       config_params=cfg)
+    finally:
+        ds_logger.propagate = False
+    msgs = [r.message for r in caplog.records
+            if "DISARMED" in r.message and "progressive_layer_drop"
+            in r.message]
+    assert msgs, "PipelineEngine must warn that PLD is disarmed"
+    assert engine.progressive_layer_drop is None
+    data = random_dataloader(8, 32, 4, seed=0)
+    assert np.isfinite(engine.train_batch(data_iter=data))
+
+
 def test_engine_injects_and_advances_theta():
     model = PLDModel()
     cfg = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,

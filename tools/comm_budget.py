@@ -51,6 +51,10 @@ def _leaves(shapes, dp):
             for n, s in shapes]
 
 
+# pipeline p2p boundary: one micro-batch of activations crossing a stage
+# boundary of the gpt2-350m-ish model (micro=1, seq x hidden)
+_P2P_ELEMS = _S * _H
+
 CONFIGS = {
     "gpt2-350m-ish/dp8/stage2/dense-bf16": dict(
         shapes=GPT2ISH, dp=8, quantized_gradients=False),
@@ -67,6 +71,16 @@ CONFIGS = {
                                    quantized_gradients=False),
     "mlp16/dp8/stage2/qgz": dict(shapes=MLP16, dp=8,
                                  quantized_gradients=True),
+    # pipeline p2p (send/recv per micro per chunk boundary, bf16
+    # activations): interleaved v=2 pays (S*v-1)/(S-1) x the 1f1b volume —
+    # the boundary-crossing cost of the ~1/v bubble win, budgeted so it
+    # cannot silently grow further
+    "gpt2-350m-ish/pipe2/gas8/p2p-1f1b": dict(
+        pipe=2, gas=8, boundary_elems=_P2P_ELEMS),
+    "gpt2-350m-ish/pipe4/gas8/p2p-1f1b": dict(
+        pipe=4, gas=8, boundary_elems=_P2P_ELEMS),
+    "gpt2-350m-ish/pipe4/gas8/p2p-interleaved-v2": dict(
+        pipe=4, gas=8, boundary_elems=_P2P_ELEMS, virtual_stages=2),
 }
 
 
@@ -74,6 +88,22 @@ def compute_volumes():
     """{config name: {total/grad/param/inter bytes per step}}."""
     out = {}
     for name, cfg in CONFIGS.items():
+        if "pipe" in cfg:
+            colls = ca.pipe_p2p_collectives(
+                cfg["boundary_elems"], cfg["gas"], stages=cfg["pipe"],
+                virtual_stages=cfg.get("virtual_stages", 1),
+                act_dtype=cfg.get("act_dtype", "bfloat16"))
+            out[name] = {
+                "total_bytes_per_step":
+                    sum(c.bytes_per_step for c in colls),
+                "p2p_act_bytes_per_step":
+                    sum(c.bytes_per_step for c in colls
+                        if c.name.startswith("p2p_act")),
+                "p2p_grad_bytes_per_step":
+                    sum(c.bytes_per_step for c in colls
+                        if c.name.startswith("p2p_grad")),
+            }
+            continue
         dp = cfg["dp"]
         report = ca.volume_report(
             _leaves(cfg["shapes"], dp), dp,

@@ -5,15 +5,20 @@ Measures, on the virtual 8-device CPU mesh (or real chips when present):
 1. step-time table: the SAME tiny GPT-2 trained monolithic (pipe=1) vs
    pipe=2 and pipe=4, fixed global batch and gas — what pipelining costs
    or buys end to end;
-2. host dispatch overhead per instruction: the tick loop's per-instruction
-   enqueue cost, measured by timing a no-op jitted dispatch per stage
-   submesh and counting the schedule's instructions — on real TPUs
-   dispatch is async, so this bounds the host-side serialization the
-   1F1B overlap has to hide;
-3. the 1F1B ideal bubble fraction (S-1)/(M+S-1) for context.
+2. host dispatch overhead per instruction: the interpreter's per-
+   instruction enqueue cost, measured by timing a no-op jitted dispatch
+   per stage submesh and counting the schedule's instructions — on real
+   TPUs dispatch is async, so this bounds the host-side serialization the
+   schedule overlap has to hide;
+3. the ANALYTIC bubble fraction of the selected schedule next to the
+   measured step time, from runtime/pipe/bubble_accounting's tick
+   simulation (both the equal-f/b model behind the classic
+   (S-1)/(M+S-1) formula and the default f=1,b=2 model) — so a
+   BENCH_NOTES schedule comparison is one command.
 
 Usage: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-           python tools/pipe_bench.py [--steps 8] [--gas 4]
+           python tools/pipe_bench.py [--steps 8] [--gas 4] \
+               [--schedule 1f1b|interleaved|zb-h1] [--virtual-stages 2]
 Prints one JSON line per configuration; paste into BENCH_NOTES.md.
 """
 import argparse
@@ -43,18 +48,29 @@ def main():
     p.add_argument("--seq", type=int, default=64)
     p.add_argument("--layers", type=int, default=4)
     p.add_argument("--embd", type=int, default=64)
+    p.add_argument("--schedule", default="1f1b",
+                   choices=["1f1b", "interleaved", "zb-h1"],
+                   help="pipeline schedule for the pipe>1 configs")
+    p.add_argument("--virtual-stages", type=int, default=1,
+                   help="model chunks per stage (interleaved schedule)")
+    p.add_argument("--untied-head", action="store_true",
+                   help="untie the LM head from the embedding (zb-h1 is "
+                        "blocked by tied weights)")
     p.add_argument("--real-tpu", action="store_true")
     args = p.parse_args()
 
     if _CPU_MODE:
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
+        if hasattr(jax.config, "jax_num_cpu_devices"):
+            # newer jax; on 0.4.x the XLA_FLAGS device-count flag set at
+            # import (above) already provides the 8 virtual devices
+            jax.config.update("jax_num_cpu_devices", 8)
     import jax.numpy as jnp
 
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
     from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline_module
-    from deepspeed_tpu.runtime.pipe import schedule as sched_lib
+    from deepspeed_tpu.runtime.pipe import bubble_accounting as ba
 
     n_dev = len(jax.devices())
     cfg = GPT2Config(vocab_size=256, n_positions=args.seq, n_embd=args.embd,
@@ -74,8 +90,12 @@ def main():
               "gradient_accumulation_steps": gas,
               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
               "mesh": {"pipe": pipe, "data": dp},
+              "pipeline": {"schedule": args.schedule if pipe > 1 else "1f1b",
+                           "virtual_stages": args.virtual_stages
+                           if pipe > 1 else 1},
               "steps_per_print": 10 ** 9}
-        model = gpt2_pipeline_module(cfg, partition_method="uniform") \
+        model = gpt2_pipeline_module(cfg, partition_method="uniform",
+                                     untied_head=args.untied_head) \
             if pipe > 1 else GPT2Model(cfg)
         engine, _, _, _ = deepspeed_tpu.initialize(model=model,
                                                    config_params=ds)
@@ -92,14 +112,14 @@ def main():
         out = {"pipe": pipe, "dp": dp, "gas": gas,
                "global_batch": global_bs, "step_ms": round(step_ms, 2)}
         if pipe > 1:
-            # schedule shape: EXACT per-stage instruction streams (first/
-            # last stages omit recv/send legs, so stage 0 x pipe would
-            # overcount); host enqueue cost timed against each stage's
-            # actual submesh device
-            n_instr = sum(
-                sum(len(step) for step in sched_lib.TrainSchedule(
-                    micro_batches=gas, stages=pipe, stage_id=s).steps())
-                for s in range(pipe))
+            # schedule shape: EXACT per-stage compiled instruction streams
+            # (first/last stages omit recv/send legs, so stage 0 x pipe
+            # would overcount); host enqueue cost timed against each
+            # stage's actual submesh device
+            sim = engine.pipeline_report()
+            sim_eq = engine.pipeline_report(
+                costs=ba.CostModel.equal_fwd_bwd())
+            n_instr = sim["total_instructions"]
             devs = [m.devices.flat[0] for m in engine._submeshes] \
                 if hasattr(engine, "_submeshes") else [jax.devices()[0]]
             reps = 200 // len(devs)
@@ -114,13 +134,22 @@ def main():
                 for noop, x in noops:
                     noop(x)
             enqueue_us = (time.time() - t0) / (reps * len(devs)) * 1e6
-            bubble = (pipe - 1) / (gas + pipe - 1)
             out.update({
+                "schedule": engine.pipe_schedule,
+                "virtual_stages": engine.virtual_stages,
                 "instructions_per_step": n_instr,
                 "enqueue_us_per_dispatch": round(enqueue_us, 1),
                 "host_dispatch_ms_per_step":
                     round(n_instr * enqueue_us / 1000.0, 2),
-                "ideal_1f1b_bubble_fraction": round(bubble, 3),
+                "analytic_bubble_fraction":
+                    round(sim["bubble_fraction"], 3),
+                "analytic_bubble_fraction_equal_fb":
+                    round(sim_eq["bubble_fraction"], 3),
+                "ideal_1f1b_bubble_fraction":
+                    round(ba.ideal_1f1b_bubble(gas, pipe), 3),
+                "p2p_bytes_per_step":
+                    sim["p2p"]["measured_bytes_per_step"],
+                "peak_live_buffers": sim["peak_live_buffers"],
             })
         print(json.dumps(out), flush=True)
         return step_ms

@@ -606,9 +606,11 @@ class PipelineEngine(DeepSpeedEngine):
             with jax.set_mesh(self._chunk_mesh(s)):
                 stats.append(self._stage_jits[s]["sqnorm"](
                     self.stage_states[s].accum))
-        for sq, finite in stats:
-            sq_total += float(jax.device_get(sq))
-            all_finite &= bool(jax.device_get(finite))
+        # one batched fetch for all chunks: a device_get per chunk would
+        # serialize host<->device once per loop turn (graftlint host-sync)
+        for sq, finite in jax.device_get(stats):
+            sq_total += float(sq)
+            all_finite &= bool(finite)
 
         scale = self._pipe_scaler.cur_scale
         if all_finite:
@@ -650,12 +652,15 @@ class PipelineEngine(DeepSpeedEngine):
                 self._stage_jits[-1]["mean_scalar"](losses)))
         # mid-chunk aux losses (MoE load balance) join the reported
         # objective so train_batch returns the same number regardless of
-        # stage count (the last chunk's own aux is already inside `loss`)
+        # stage count (the last chunk's own aux is already inside `loss`).
+        # Per-chunk reductions dispatch async; ONE fetch collects them all.
+        aux_means = []
         for s, auxes in enumerate(mid_auxes):
             if auxes:
                 with jax.set_mesh(self._chunk_mesh(s)):
-                    loss += float(jax.device_get(
-                        self._stage_jits[s]["mean_scalar"](auxes)))
+                    aux_means.append(self._stage_jits[s]["mean_scalar"](auxes))
+        if aux_means:
+            loss += float(np.sum(jax.device_get(aux_means)))
         self._last_loss = loss
         self._last_metrics = {
             "overflow": not all_finite,
@@ -692,7 +697,8 @@ class PipelineEngine(DeepSpeedEngine):
                         x = jits["eval_fwd"](self.stage_states[q].params, x, rng)
                         x = self._transfer(
                             x, self.grid.chunk_owner_stage(q + 1))
-        out = float(np.mean([float(jax.device_get(l)) for l in losses]))
+        # single batched fetch: per-loss device_get would sync once per micro
+        out = float(np.mean(jax.device_get(losses)))
         if self._watchdog is not None:
             # eval between optimizer steps is progress, not a stalled step
             self._watchdog.heartbeat()

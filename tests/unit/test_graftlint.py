@@ -1,0 +1,542 @@
+"""graftlint framework + rule-catalog tests.
+
+Three layers:
+1. framework — registry, suppression comments, baseline add/expire
+   semantics, fingerprint stability, reporters, CLI exit codes;
+2. rules — every AST rule class has known-bad fixture snippets it fires
+   on and known-good (fixed) twins it stays quiet on (the acceptance
+   criterion for each rule class);
+3. repo — the full rule set over the real tree is exercised by
+   tests/unit/test_lint_guards.py (tier-1), not here, so this file stays
+   jax-free and fast.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+from tools.graftlint import core  # noqa: E402
+from tools.graftlint.core import (REGISTRY, load_baseline, run_paths,  # noqa: E402
+                                  run_source, save_baseline)
+
+EXPECTED_RULES = {"bare-except", "donated-state", "host-sync",
+                  "rank-branch-collective", "disarmed-discipline"}
+
+
+def lint(src, path="deepspeed_tpu/x.py", rules=None):
+    picked = None if rules is None else [REGISTRY[r] for r in rules]
+    return run_source(src, path, rules=picked)
+
+
+def rule_names(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# framework
+# ---------------------------------------------------------------------------
+
+def test_registry_catalog():
+    assert EXPECTED_RULES <= set(REGISTRY)
+    for name, rule in REGISTRY.items():
+        assert rule.name == name and rule.description
+
+
+def test_syntax_error_surfaces_as_finding():
+    got = lint("def f(:\n")
+    assert len(got) == 1 and got[0].rule == "syntax"
+
+
+def test_findings_sorted_and_formatted():
+    src = ("try:\n    x()\nexcept:\n    raise ValueError()\n"
+           "try:\n    y()\nexcept Exception:\n    pass\n")
+    got = lint(src)
+    assert [f.line for f in got] == sorted(f.line for f in got)
+    assert got[0].format().startswith("deepspeed_tpu/x.py:3: [bare-except]")
+
+
+def test_suppression_same_line_prev_line_and_wrong_rule():
+    base = "try:\n    x()\nexcept:{}\n    raise ValueError()\n"
+    assert rule_names(lint(base.format(""))) == ["bare-except"]
+    assert lint(base.format("  # graftlint: disable=bare-except")) == []
+    # suppression on the PRECEDING line (wrapped statements)
+    src = ("try:\n    x()\n# graftlint: disable=bare-except\nexcept:\n"
+           "    raise ValueError()\n")
+    assert lint(src) == []
+    # a different rule's token does not suppress
+    assert rule_names(lint(base.format(
+        "  # graftlint: disable=host-sync"))) == ["bare-except"]
+    # disable=all suppresses any rule
+    assert lint(base.format("  # graftlint: disable=all")) == []
+
+
+def test_rule_scoping_by_path():
+    src = ("class E:\n"
+           "    def _arm_x(self):\n"
+           "        self._x_armed = True\n")
+    assert rule_names(lint(src, "deepspeed_tpu/runtime/foo.py")) \
+        == ["disarmed-discipline"]
+    # the discipline is an engine-source contract, not a test-file one
+    assert lint(src, "tests/unit/test_foo.py") == []
+
+
+def _write(tmp, rel, text):
+    p = os.path.join(tmp, rel)
+    os.makedirs(os.path.dirname(p), exist_ok=True)
+    with open(p, "w", encoding="utf-8") as f:
+        f.write(text)
+    return p
+
+
+BAD_FILE = "def f():\n    try:\n        g()\n    except:\n        raise V()\n"
+GOOD_FILE = "def f():\n    g()\n"
+
+
+def test_baseline_add_then_expire(tmp_path):
+    tmp = str(tmp_path)
+    baseline = os.path.join(tmp, "baseline.json")
+    _write(tmp, "pkg/mod.py", BAD_FILE)
+
+    r1 = run_paths(roots=("pkg",), baseline_path=baseline, repo_root=tmp)
+    assert len(r1.new) == 1 and not r1.baselined and not r1.stale
+    assert r1.exit_code == 1
+
+    save_baseline(r1, path=baseline, notes={
+        fp: "intentional fixture" for fp in r1.fingerprints})
+    r2 = run_paths(roots=("pkg",), baseline_path=baseline, repo_root=tmp)
+    assert not r2.new and len(r2.baselined) == 1 and not r2.stale
+    assert r2.exit_code == 0
+    entry = load_baseline(baseline)["entries"][0]
+    assert entry["note"] == "intentional fixture"
+    assert entry["rule"] == "bare-except"
+
+    # fix the violation: the entry goes stale, lint still passes, and a
+    # baseline update prunes it
+    _write(tmp, "pkg/mod.py", GOOD_FILE)
+    r3 = run_paths(roots=("pkg",), baseline_path=baseline, repo_root=tmp)
+    assert not r3.new and not r3.baselined and len(r3.stale) == 1
+    assert r3.exit_code == 0
+    save_baseline(r3, path=baseline)
+    assert load_baseline(baseline)["entries"] == []
+
+
+def test_baseline_fingerprint_survives_line_moves(tmp_path):
+    tmp = str(tmp_path)
+    baseline = os.path.join(tmp, "baseline.json")
+    _write(tmp, "pkg/mod.py", BAD_FILE)
+    r1 = run_paths(roots=("pkg",), baseline_path=baseline, repo_root=tmp)
+    save_baseline(r1, path=baseline)
+    # shift the violation down two lines: same text -> same fingerprint
+    _write(tmp, "pkg/mod.py", "\n\n" + BAD_FILE)
+    r2 = run_paths(roots=("pkg",), baseline_path=baseline, repo_root=tmp)
+    assert not r2.new and len(r2.baselined) == 1 and not r2.stale
+
+
+def test_scoped_baseline_update_preserves_out_of_scope(tmp_path):
+    """A scoped run (subset of roots or rules) must neither report
+    out-of-coverage baseline entries as stale nor delete them on a
+    baseline update — the baseline is a whole-repo artifact."""
+    tmp = str(tmp_path)
+    baseline = os.path.join(tmp, "b.json")
+    _write(tmp, "a/f.py", BAD_FILE)
+    _write(tmp, "b/g.py", BAD_FILE)
+    r_full = run_paths(roots=("a", "b"), baseline_path=baseline,
+                       repo_root=tmp)
+    save_baseline(r_full, path=baseline,
+                  notes={fp: "keep" for fp in r_full.fingerprints})
+    assert len(load_baseline(baseline)["entries"]) == 2
+
+    # root-scoped: b/ is out of coverage — not stale, survives the update
+    r_a = run_paths(roots=("a",), baseline_path=baseline, repo_root=tmp)
+    assert not r_a.new and not r_a.stale
+    save_baseline(r_a, path=baseline)
+    entries = load_baseline(baseline)["entries"]
+    assert {e["path"] for e in entries} == {"a/f.py", "b/g.py"}
+    assert all(e["note"] == "keep" for e in entries)
+
+    # rule-scoped: bare-except entries are out of coverage for host-sync
+    r_rule = run_paths(roots=("a", "b"), rules=[REGISTRY["host-sync"]],
+                       baseline_path=baseline, repo_root=tmp)
+    assert not r_rule.stale
+    save_baseline(r_rule, path=baseline)
+    assert len(load_baseline(baseline)["entries"]) == 2
+
+
+def test_run_paths_skips_pycache(tmp_path):
+    tmp = str(tmp_path)
+    _write(tmp, "pkg/__pycache__/junk.py", BAD_FILE)
+    _write(tmp, "pkg/ok.py", GOOD_FILE)
+    r = run_paths(roots=("pkg",), repo_root=tmp, use_baseline=False)
+    assert not r.new
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _cli(*args, cwd=REPO):
+    return subprocess.run([sys.executable, "-m", "tools.graftlint", *args],
+                          cwd=cwd, capture_output=True, text=True,
+                          timeout=120)
+
+
+def test_cli_clean_dir_exits_zero():
+    proc = _cli("tools/graftlint", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["summary"]["new"] == 0
+    assert set(EXPECTED_RULES) <= set(payload["rules"])
+
+
+def test_cli_new_finding_exits_nonzero(tmp_path):
+    bad = _write(str(tmp_path), "bad.py", BAD_FILE)
+    proc = _cli(bad, "--no-baseline")
+    assert proc.returncode == 1
+    assert "[bare-except]" in proc.stdout
+
+
+def test_cli_json_shape_on_findings(tmp_path):
+    bad = _write(str(tmp_path), "bad.py", BAD_FILE)
+    proc = _cli(bad, "--no-baseline", "--json")
+    payload = json.loads(proc.stdout)
+    assert payload["summary"]["new"] == 1
+    f = payload["new"][0]
+    assert f["rule"] == "bare-except" and f["line"] == 4 and f["message"]
+
+
+def test_cli_baseline_update_roundtrip(tmp_path):
+    tmp = str(tmp_path)
+    bad = _write(tmp, "bad.py", BAD_FILE)
+    baseline = os.path.join(tmp, "b.json")
+    assert _cli(bad, "--baseline", baseline).returncode == 1
+    assert _cli(bad, "--baseline", baseline,
+                "--baseline-update").returncode == 0
+    assert _cli(bad, "--baseline", baseline).returncode == 0
+    assert _cli(bad, "--baseline", baseline,
+                "--strict-stale").returncode == 0
+    _write(tmp, "bad.py", GOOD_FILE)
+    assert _cli(bad, "--baseline", baseline).returncode == 0
+    assert _cli(bad, "--baseline", baseline,
+                "--strict-stale").returncode == 1
+
+
+def test_nonexistent_root_raises_not_empty_scan(tmp_path):
+    """A missing root must error, not silently scan nothing — an empty
+    scan feeding --baseline-update would wipe the baseline."""
+    with pytest.raises(FileNotFoundError, match="no_such_dir"):
+        run_paths(roots=("no_such_dir",), repo_root=str(tmp_path),
+                  use_baseline=False)
+    proc = _cli("no_such_dir_anywhere")
+    assert proc.returncode == 2 and "not found" in proc.stderr
+
+
+def test_cli_relative_roots_resolve_from_user_cwd(tmp_path):
+    """`python -m tools.graftlint mydir` from any cwd lints that dir."""
+    tmp = str(tmp_path)
+    _write(tmp, "mydir/f.py", BAD_FILE)
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "mydir", "--no-baseline"],
+        cwd=tmp, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "[bare-except]" in proc.stdout
+
+
+def test_cli_rule_subset_and_unknown():
+    assert _cli("--list-rules").returncode == 0
+    proc = _cli("tools/graftlint", "--rules", "bare-except")
+    assert proc.returncode == 0
+    assert _cli("--rules", "no-such-rule").returncode == 2
+
+
+def test_legacy_shim_still_works():
+    """Satellite: tools/check_no_bare_except.py survives as a shim — same
+    CLI, same check_source API (exercised by test_lint_guards.py)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "check_no_bare_except.py"),
+         "tools/graftlint"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# rule: donated-state
+# ---------------------------------------------------------------------------
+
+DONATION_BAD = """
+def t(engine, np, b):
+    p0 = engine.state.params["w1"]
+    engine.train_batch(batch=b)
+    return np.sum(p0)
+"""
+
+DONATION_GOOD_MATERIALIZED = """
+def t(engine, np, b, jax):
+    p0 = jax.device_get(engine.state.params["w1"])
+    engine.train_batch(batch=b)
+    return np.sum(p0)
+"""
+
+DONATION_GOOD_REREAD = """
+def t(engine, np, b):
+    engine.train_batch(batch=b)
+    return np.sum(engine.state.params["w1"])
+"""
+
+DONATION_GOOD_REBOUND = """
+def t(engine, np, b):
+    p0 = engine.state.params["w1"]
+    engine.train_batch(batch=b)
+    p0 = engine.state.params["w1"]
+    return np.sum(p0)
+"""
+
+DONATION_BAD_STAGE = """
+def t(engine, b):
+    acc = engine.stage_states[0].accum
+    engine.train_batch(batch=b)
+    return acc
+"""
+
+
+def test_donated_state_fires_on_held_leaf():
+    got = lint(DONATION_BAD, "tests/unit/t.py", rules=["donated-state"])
+    assert rule_names(got) == ["donated-state"] and got[0].line == 5
+    assert "donated" in got[0].message
+
+
+def test_donated_state_quiet_on_fixes():
+    for src in (DONATION_GOOD_MATERIALIZED, DONATION_GOOD_REREAD,
+                DONATION_GOOD_REBOUND):
+        assert lint(src, "tests/unit/t.py", rules=["donated-state"]) == [], src
+
+
+def test_donated_state_tracks_stage_states():
+    got = lint(DONATION_BAD_STAGE, "deepspeed_tpu/runtime/x.py",
+               rules=["donated-state"])
+    assert rule_names(got) == ["donated-state"]
+
+
+def test_donated_state_use_before_step_is_fine():
+    src = ("def t(engine, np, b):\n"
+           "    p0 = engine.state.params\n"
+           "    s = np.sum(p0)\n"
+           "    engine.step()\n"
+           "    return s\n")
+    assert lint(src, "tests/unit/t.py", rules=["donated-state"]) == []
+
+
+# ---------------------------------------------------------------------------
+# rule: host-sync
+# ---------------------------------------------------------------------------
+
+HS_TRACED_BAD = """
+import jax
+import numpy as np
+def micro(state, batch):
+    return float(np.asarray(state.accum))
+fn = jax.jit(micro)
+"""
+
+HS_TRACED_GOOD = """
+import jax
+import jax.numpy as jnp
+def micro(state, batch):
+    return jnp.asarray(state.accum)
+fn = jax.jit(micro)
+"""
+
+HS_FACTORY_BAD = """
+def _make_micro_fn(self):
+    def micro(state, batch):
+        return jax.device_get(state.accum)
+    return micro
+"""
+
+HS_HOT_LOOP_BAD = """
+class E:
+    def train_batch(self, micros):
+        for m in micros:
+            loss = self._jit(m)
+            total += float(jax.device_get(loss))
+        return total
+"""
+
+HS_HOT_LOOP_GOOD = """
+class E:
+    def train_batch(self, micros):
+        losses = []
+        for m in micros:
+            losses.append(self._jit(m))
+        return float(np.sum(jax.device_get(losses)))
+"""
+
+
+def test_host_sync_fires_in_traced_fn():
+    got = lint(HS_TRACED_BAD, rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"]
+    assert "traced" in got[0].message
+
+
+def test_host_sync_quiet_on_jnp_in_traced_fn():
+    assert lint(HS_TRACED_GOOD, rules=["host-sync"]) == []
+
+
+def test_host_sync_fires_in_make_factory_defs():
+    got = lint(HS_FACTORY_BAD, rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"]
+
+
+@pytest.mark.parametrize("path", ["deepspeed_tpu/runtime/engine.py",
+                                  "deepspeed_tpu/runtime/pipe/engine.py",
+                                  "bench.py", "tools/pipe_bench.py"])
+def test_host_sync_fires_in_hot_loop(path):
+    got = lint(HS_HOT_LOOP_BAD, path, rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"], path
+    assert "per-iteration loop" in got[0].message
+
+
+def test_host_sync_quiet_on_batched_fetch_after_loop():
+    assert lint(HS_HOT_LOOP_GOOD, "deepspeed_tpu/runtime/engine.py",
+                rules=["host-sync"]) == []
+
+
+def test_host_sync_hot_loop_scoped_to_hot_files():
+    # the same loop in an arbitrary module is host-side code, not a
+    # schedule hot path — only the traced-fn context applies there
+    assert lint(HS_HOT_LOOP_BAD, "deepspeed_tpu/utils/foo.py",
+                rules=["host-sync"]) == []
+
+
+def test_host_sync_comprehension_counts_as_loop():
+    src = ("class E:\n"
+           "    def eval_batch(self, losses, np, jax):\n"
+           "        return float(np.mean([float(jax.device_get(l)) "
+           "for l in losses]))\n")
+    got = lint(src, "deepspeed_tpu/runtime/pipe/engine.py",
+               rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"]
+
+
+# ---------------------------------------------------------------------------
+# rule: rank-branch-collective
+# ---------------------------------------------------------------------------
+
+SPMD_BAD = """
+def body(x, jax):
+    if jax.lax.axis_index("data") == 0:
+        x = jax.lax.psum(x, "data")
+    return x
+"""
+
+SPMD_BAD_ELSE = """
+def body(x, jax):
+    if jax.lax.axis_index("data") == 0:
+        pass
+    else:
+        x = jax.lax.all_gather(x, "data")
+    return x
+"""
+
+SPMD_BAD_HOST = """
+def save(jax, mu, payload):
+    if jax.process_index() == 0:
+        return mu.process_allgather(payload)
+"""
+
+SPMD_GOOD = """
+def body(x, jax, jnp):
+    y = jax.lax.psum(x, "data")
+    return jnp.where(jax.lax.axis_index("data") == 0, y, x)
+"""
+
+SPMD_GOOD_UNIFORM_GUARD = """
+def save(jax, mu, payload):
+    if jax.process_count() > 1:
+        return mu.process_allgather(payload)
+    return payload
+"""
+
+
+def test_rank_branch_collective_fires():
+    got = lint(SPMD_BAD, rules=["rank-branch-collective"])
+    assert rule_names(got) == ["rank-branch-collective"]
+    assert "psum" in got[0].message and "deadlock" in got[0].message
+
+
+def test_rank_branch_collective_fires_in_else_arm():
+    got = lint(SPMD_BAD_ELSE, rules=["rank-branch-collective"])
+    assert rule_names(got) == ["rank-branch-collective"]
+
+
+def test_rank_branch_host_barrier_fires():
+    got = lint(SPMD_BAD_HOST, rules=["rank-branch-collective"])
+    assert rule_names(got) == ["rank-branch-collective"]
+
+
+def test_rank_branch_collective_quiet_on_fixes():
+    assert lint(SPMD_GOOD, rules=["rank-branch-collective"]) == []
+    # process_count is uniform across ranks: not a divergence hazard
+    assert lint(SPMD_GOOD_UNIFORM_GUARD,
+                rules=["rank-branch-collective"]) == []
+
+
+# ---------------------------------------------------------------------------
+# rule: disarmed-discipline
+# ---------------------------------------------------------------------------
+
+DISARM_BAD = """
+class E:
+    def _arm_thing(self):
+        self._thing_armed = False
+        if self.config.thing and self.dp > 1:
+            self._thing_armed = True
+"""
+
+DISARM_GOOD = DISARM_BAD + """
+        elif self.config.thing:
+            log_dist("thing DISARMED — requires dp > 1",
+                     ranks=[0], level=logging.WARNING)
+"""
+
+DISARM_BAD_ATTR_ONLY = """
+class E:
+    def configure(self):
+        self._wire_armed = self.dp > 1
+"""
+
+
+def test_disarmed_discipline_fires_without_warning_path():
+    got = lint(DISARM_BAD, rules=["disarmed-discipline"])
+    assert rule_names(got) == ["disarmed-discipline"] and got[0].line == 3
+    assert "DISARMED" in got[0].message
+
+
+def test_disarmed_discipline_quiet_with_warning():
+    assert lint(DISARM_GOOD, rules=["disarmed-discipline"]) == []
+
+
+def test_disarmed_discipline_catches_armed_attr_outside_arm_fns():
+    got = lint(DISARM_BAD_ATTR_ONLY, rules=["disarmed-discipline"])
+    assert rule_names(got) == ["disarmed-discipline"]
+
+
+# ---------------------------------------------------------------------------
+# rule: bare-except (folded from check_no_bare_except)
+# ---------------------------------------------------------------------------
+
+def test_bare_except_rule_matches_legacy_checker():
+    src = "try:\n    x()\nexcept Exception:\n    pass\n"
+    got = lint(src, rules=["bare-except"])
+    assert rule_names(got) == ["bare-except"]
+    # the legacy opt-out marker keeps working through the rule
+    src_ok = ("try:\n    x()\n"
+              "except Exception:  # lint: allow-broad-except\n    pass\n")
+    assert lint(src_ok, rules=["bare-except"]) == []

@@ -1,0 +1,226 @@
+"""HLO-contract tier-1 tests (tools/graftlint/hlo_contracts.py).
+
+Two layers:
+
+1. **fixture proofs** — each contract helper fires on a seeded violation
+   and stays quiet on the fixed twin (the same known-bad/known-good
+   discipline as the AST rule fixtures in test_graftlint.py);
+2. **engine contracts** — the engine's key jits are lowered and held to
+   their performance contracts on the 8-device CPU mesh:
+   - the micro-step jit contains NO host transfers (a stray
+     debug-print/callback would stall every micro-batch);
+   - the quantized (qgZ) gradient wire moves int8 payloads + per-block
+     fp32 scales only — no fp32 gradient-sized collective survives, and
+     total collective bytes stay within runtime/comm_accounting.py's
+     analytic budget;
+   - the pipeline boundary activation leaves a bf16 stage in bf16 (an
+     f32 boundary would double the p2p bytes the schedule budgets).
+
+Note on the upcast fixture: XLA freely COMMUTES dtype converts across
+collectives (a post-gather astype(f32) gets hoisted before the gather,
+fattening the wire), and the CPU backend additionally legalizes bf16
+collectives by upcasting them to f32.  The only wire dtype that
+reliably survives compilation sub-fp32 is int8 — exactly why the engine
+quantizes payloads and pins them with sharding constraints
+(test_quantization.py::test_int8_allgather_rides_the_wire_as_int8), and
+why these contracts assert on the int8 wire rather than a bf16 one.
+"""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import deepspeed_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+from tools.graftlint import hlo_contracts as hc  # noqa: E402
+from tests.unit.simple_model import SimpleModel  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# fixture proofs: each contract fires on a seeded violation, quiets on fix
+# ---------------------------------------------------------------------------
+
+def test_host_transfer_contract_fires_and_quiets():
+    def seeded(x):
+        # the violation: a host callback inside the jitted computation
+        # (deliberately seeded — the AST host-sync rule flags it too)
+        return jax.pure_callback(
+            lambda a: np.asarray(a) * 2.0,  # graftlint: disable=host-sync
+            jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    x = jnp.ones(8, jnp.float32)
+    bad_hlo = jax.jit(seeded).lower(x).compile().as_text()
+    hits = hc.host_transfer_ops(bad_hlo)
+    assert hits and "callback" in hits[0]
+    with pytest.raises(hc.HloContractError, match="host-transfer"):
+        hc.assert_no_host_transfers(bad_hlo, "fixture jit")
+
+    good_hlo = jax.jit(lambda y: y * 2.0).lower(x).compile().as_text()
+    hc.assert_no_host_transfers(good_hlo, "fixture jit")
+
+
+def _mesh8():
+    devs = jax.devices()[:8]
+    assert len(devs) == 8
+    return Mesh(np.asarray(devs), ("data",))
+
+
+def test_fp32_upcast_contract_fires_and_quiets():
+    mesh = _mesh8()
+    xs = jnp.asarray(np.random.default_rng(0).standard_normal((8, 128)),
+                     jnp.bfloat16)
+
+    def lower(body):
+        fn = jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                           out_specs=P("data"))
+        return jax.jit(fn).lower(xs).compile().as_text()
+
+    # seeded: the activation is upcast before it crosses the wire
+    bad = lower(lambda v: jax.lax.all_gather(v.astype(jnp.float32), "data"))
+    assert hc.fp32_collectives(bad, min_elements=128)
+    with pytest.raises(hc.HloContractError, match="fp32 payloads"):
+        hc.assert_no_fp32_collectives(bad, min_elements=128,
+                                      what="bf16 gather fixture")
+
+    # fixed: the payload crosses the wire quantized to int8 (the engine
+    # idiom) — astype-after-gather would NOT fix it (XLA hoists the
+    # convert before the collective; see module docstring), and bf16
+    # itself gets f32-legalized by the CPU backend
+    def quantized_wire(v):
+        scale = jnp.max(jnp.abs(v.astype(jnp.float32))) / 127.0 + 1e-8
+        q = jnp.round(v.astype(jnp.float32) / scale).astype(jnp.int8)
+        g = jax.lax.all_gather(q, "data")
+        return g.astype(jnp.bfloat16) * scale.astype(jnp.bfloat16)
+
+    good = lower(quantized_wire)
+    hc.assert_no_fp32_collectives(good, min_elements=128,
+                                  what="int8 gather fixture")
+    assert any(c.dtype == "s8" for c in hc.collective_ops(good))
+
+
+def test_collective_budget_contract_fires_and_quiets():
+    mesh = _mesh8()
+    xs = jnp.asarray(np.ones((8, 1024), np.float32))
+    fn = jax.shard_map(lambda v: jax.lax.psum(v, "data"), mesh=mesh,
+                       in_specs=P("data"), out_specs=P("data"))
+    hlo = jax.jit(fn).lower(xs).compile().as_text()
+    total = hc.collective_bytes(hlo)
+    assert total > 0
+    assert hc.assert_collective_budget(hlo, total, "psum fixture") == total
+    with pytest.raises(hc.HloContractError, match="over the analytic"):
+        hc.assert_collective_budget(hlo, total // 2, "psum fixture")
+
+
+def test_entry_output_dtypes_parses_signature():
+    x = jnp.ones(4, jnp.float32)
+    hlo = jax.jit(lambda y: y.astype(jnp.bfloat16)).lower(x) \
+        .compile().as_text()
+    assert hc.entry_output_dtypes(hlo) == ["bf16"]
+
+
+# ---------------------------------------------------------------------------
+# engine contracts
+# ---------------------------------------------------------------------------
+
+HIDDEN = 32
+
+
+def _engine(**zero_over):
+    zero = {"stage": 2}
+    zero.update(zero_over)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=HIDDEN), config_params={
+            "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.02}},
+            "zero_optimization": zero,
+            "mesh": {"data": 8}, "steps_per_print": 10 ** 9})
+    return engine
+
+
+def _micro_hlo(engine):
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.standard_normal((8, HIDDEN)).astype(np.float32),
+             "y": rng.integers(0, 4, (8,)).astype(np.int32)}
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    dev = engine._shard_batch(batch)
+    with jax.set_mesh(engine.mesh):
+        return engine._jit_micro.lower(engine.state, dev).compile().as_text()
+
+
+def test_micro_step_jit_has_no_host_transfers(eight_devices):
+    """The per-micro hot path must be pure device work: any infeed/
+    outfeed/callback would serialize host<->device once per micro-batch
+    — the compiled complement of the AST host-sync rule."""
+    hc.assert_no_host_transfers(_micro_hlo(_engine()),
+                                "stage-2 micro-step jit")
+
+
+def test_qgz_wire_is_quantized_and_within_budget(eight_devices):
+    """The qgZ gradient exchange contract: no fp32 gradient-sized
+    collective survives compilation (payloads ride s8 + small f32
+    scales), and total collective bytes stay within the analytic
+    per-step budget from comm_accounting (HLO counts per-shard output
+    bytes, which the ring-model budget upper-bounds)."""
+    engine = _engine(quantized_gradients=True)
+    hlo = _micro_hlo(engine)
+    assert engine._qgz_armed
+    hc.assert_no_host_transfers(hlo, "qgZ micro-step jit")
+    # sharp check: largest f32 payload is the per-row scales / tiny dense
+    # leaves; anything >= 512 elements means a dense grad leaked upcast
+    hc.assert_no_fp32_collectives(hlo, min_elements=512,
+                                  what="qgZ micro-step jit")
+    assert any(c.dtype == "s8" for c in hc.collective_ops(hlo)), \
+        "int8 gradient payloads missing from the compiled wire"
+    budget = engine.comm_volume_report()["grad_exchange_bytes_per_step"]
+    measured = hc.assert_collective_budget(hlo, budget,
+                                           "qgZ micro-step jit")
+    # and the quantized wire is a real win vs the dense build's HLO
+    dense_bytes = hc.collective_bytes(_micro_hlo(_engine()))
+    assert measured * 2 <= dense_bytes, (measured, dense_bytes)
+
+
+def test_pipeline_boundary_activation_stays_bf16(eight_devices):
+    """Boundary-transfer contract: a bf16 pipeline stage emits its
+    boundary activation in bf16 — an f32 boundary would double the p2p
+    bytes pipeline_report() budgets per edge."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline_module
+
+    cfg = GPT2Config(vocab_size=64, n_positions=16, n_embd=32, n_layer=2,
+                     n_head=4, dtype=jnp.bfloat16, loss_chunk_tokens=0)
+    module = gpt2_pipeline_module(cfg, partition_method="uniform")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=module, config_params={
+            "train_batch_size": 8, "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True},
+            "mesh": {"pipe": 2, "data": 2, "model": 1,
+                     "allow_partial": True},
+            "steps_per_print": 10 ** 9})
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, (2, 4, 16))
+    engine.train_batch(batch={"input_ids": ids, "labels": ids.copy()})
+
+    micro = {"input_ids": ids[0], "labels": ids[0].copy()}
+    x = engine._put_stage(engine.module.input_fn(micro), 0)
+    step_rng = jax.random.fold_in(engine._pipe_rng, 0)
+    st = engine.stage_states[0]
+    with jax.set_mesh(engine._chunk_mesh(0)):
+        hlo = engine._stage_jits[0]["fwd"].lower(
+            st.params, x, step_rng).compile().as_text()
+    assert hc.entry_output_dtypes(hlo) == ["bf16"], \
+        "stage-0 boundary activation upcast away from bf16"
+    hc.assert_no_host_transfers(hlo, "pipeline stage-0 forward jit")
+    hc.assert_no_fp32_collectives(hlo, min_elements=512,
+                                  what="pipeline stage-0 forward jit")

@@ -1,73 +1,27 @@
 #!/usr/bin/env python
 """Lint: forbid exception handlers that hide corruption.
 
-Flags, in every .py file under the given roots (default: deepspeed_tpu
-tools tests):
-
-- bare ``except:`` — catches SystemExit/KeyboardInterrupt and turns a
-  preempted checkpoint write into a silently-truncated file;
-- ``except Exception`` / ``except BaseException`` whose body is only
-  ``pass``/``...`` — the error is swallowed with no log, no re-raise, no
-  fallback.
-
-A handler may opt out with a trailing ``# lint: allow-broad-except``
-comment on its ``except`` line (there is deliberately no blanket opt-out).
+THIN SHIM — the checker now lives in graftlint as the registered rule
+``bare-except`` (tools/graftlint/rules/bare_except.py); this entrypoint
+keeps the historical CLI and the ``check_source`` import used by
+tests/unit/test_lint_guards.py working unchanged.  Prefer running the
+full suite: ``python -m tools.graftlint``.
 
 Exit status 0 = clean, 1 = violations (printed as file:line messages).
-Run directly or via tests/unit/test_lint_guards.py so regressions fail
-the suite without a separate CI system.
 """
 import argparse
-import ast
 import os
 import sys
 
-ALLOW_MARK = "lint: allow-broad-except"
+try:
+    from tools.graftlint.rules.bare_except import (ALLOW_MARK, BROAD_NAMES,
+                                                   check_source)
+except ImportError:  # imported top-level with tools/ itself on sys.path
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from graftlint.rules.bare_except import (ALLOW_MARK, BROAD_NAMES,  # noqa: F401
+                                             check_source)
+
 DEFAULT_ROOTS = ("deepspeed_tpu", "tools", "tests")
-BROAD_NAMES = {"Exception", "BaseException"}
-
-
-def _is_broad(handler_type):
-    return (isinstance(handler_type, ast.Name)
-            and handler_type.id in BROAD_NAMES)
-
-
-def _body_is_silent(body):
-    """True when the handler body cannot surface the error: only pass/... ."""
-    for stmt in body:
-        if isinstance(stmt, ast.Pass):
-            continue
-        if isinstance(stmt, ast.Expr) and \
-                isinstance(stmt.value, ast.Constant) and \
-                stmt.value.value is Ellipsis:
-            continue
-        return False
-    return True
-
-
-def check_source(source, filename="<string>"):
-    """Return [(lineno, message)] violations for one file's source text."""
-    try:
-        tree = ast.parse(source, filename=filename)
-    except SyntaxError as e:
-        return [(e.lineno or 0, f"syntax error: {e.msg}")]
-    lines = source.splitlines()
-    out = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if ALLOW_MARK in line:
-            continue
-        if node.type is None:
-            out.append((node.lineno,
-                        "bare 'except:' (catches KeyboardInterrupt/"
-                        "SystemExit; name the exceptions)"))
-        elif _is_broad(node.type) and _body_is_silent(node.body):
-            out.append((node.lineno,
-                        f"'except {node.type.id}: pass' silently swallows "
-                        f"errors (log, re-raise, or narrow it)"))
-    return sorted(out)
 
 
 def iter_py_files(roots):

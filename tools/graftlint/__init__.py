@@ -1,0 +1,23 @@
+"""graftlint — AST + HLO static analysis for JAX/TPU training hazards.
+
+Rule catalog (tools/graftlint/rules/):
+
+- ``bare-except``            silent/broad exception handlers
+- ``donated-state``          donated-buffer refs held across a step call
+- ``host-sync``              device syncs in traced fns / hot loops
+- ``rank-branch-collective`` collectives under rank-dependent branches
+- ``disarmed-discipline``    config-gated optimizations that no-op silently
+
+HLO contracts (tools/graftlint/hlo_contracts.py) assert properties of
+COMPILED jits: no host transfers, no fp32 payloads on low-precision
+wires, collective bytes within analytic budgets.
+
+CLI: ``python -m tools.graftlint [roots...] [--json] [--baseline-update]``
+— nonzero exit on new (unbaselined, unsuppressed) findings.  Docs:
+docs/tutorials/static_analysis.md.
+"""
+from .core import (DEFAULT_BASELINE, DEFAULT_ROOTS, REGISTRY,  # noqa: F401
+                   Finding, Rule, RunResult, iter_py_files, load_baseline,
+                   register, report_json, report_text, run_paths,
+                   run_source, save_baseline)
+from . import rules  # noqa: F401  (side effect: registers the catalog)

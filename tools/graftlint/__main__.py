@@ -1,0 +1,79 @@
+"""CLI: ``python -m tools.graftlint [roots...] [options]``.
+
+Exit status: 0 = no new findings (baselined/suppressed ones don't fail),
+1 = new findings (or --strict-stale with stale baseline entries),
+2 = usage error.
+
+--baseline-update rewrites tools/graftlint/baseline.json to exactly the
+current finding set (pruning stale entries, preserving notes on
+survivors).  Use it ONLY for load-bearing findings you cannot fix, and
+add a ``note`` to the entry saying why it stays.
+"""
+import argparse
+import sys
+
+from .core import (DEFAULT_BASELINE, DEFAULT_ROOTS, REGISTRY, _load_rules,
+                   report_json, report_text, run_paths, save_baseline)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST + HLO static analysis for JAX/TPU training hazards")
+    ap.add_argument("roots", nargs="*", default=list(DEFAULT_ROOTS),
+                    help=f"files/dirs to lint (default: "
+                         f"{' '.join(DEFAULT_ROOTS)})")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: tools/graftlint/"
+                         "baseline.json)")
+    ap.add_argument("--baseline-update", action="store_true",
+                    help="rewrite the baseline to the current findings "
+                         "(prunes stale entries) and exit 0")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding as new (ignore the baseline)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--strict-stale", action="store_true",
+                    help="also fail (exit 1) on stale baseline entries")
+    args = ap.parse_args(argv)
+
+    rules = _load_rules()
+    if args.list_rules:
+        for r in sorted(rules, key=lambda r: r.name):
+            print(f"{r.name}: {r.description}")
+        return 0
+    if args.rules:
+        wanted = {n.strip() for n in args.rules.split(",")}
+        unknown = wanted - set(REGISTRY)
+        if unknown:
+            print(f"graftlint: unknown rule(s) {sorted(unknown)}; "
+                  f"known: {sorted(REGISTRY)}", file=sys.stderr)
+            return 2
+        rules = [REGISTRY[n] for n in sorted(wanted)]
+
+    try:
+        result = run_paths(roots=args.roots, rules=rules,
+                           baseline_path=args.baseline,
+                           use_baseline=not args.no_baseline)
+    except FileNotFoundError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+    if args.baseline_update:
+        data = save_baseline(result, path=args.baseline)
+        print(f"graftlint: baseline updated — {len(data['entries'])} "
+              f"entr{'y' if len(data['entries']) == 1 else 'ies'}, "
+              f"{len(result.stale)} stale pruned")
+        return 0
+    print(report_json(result, rules) if args.json
+          else report_text(result, rules))
+    if result.new or (args.strict_stale and result.stale):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,161 @@
+"""HLO-contract checks — static assertions over COMPILED programs.
+
+The AST rules police source; these police what XLA actually lowered.
+Each helper takes the ``.lower(...).compile().as_text()`` HLO of a jit
+and asserts a contract the runtime's performance claims depend on:
+
+- ``assert_no_host_transfers``: the jitted hot path contains no
+  infeed/outfeed and no host-callback custom-calls (a stray
+  jax.debug.print / pure_callback / io_callback inserts a host
+  round-trip per call that no profiler attributes honestly);
+- ``assert_no_fp32_collectives``: a declared-bf16/int8 wire moves no
+  fp32 payload of gradient/activation size (an accidental upcast doubles
+  or quadruples the bytes the comm accounting budgeted);
+- ``assert_collective_budget``: total collective payload stays within an
+  analytic byte budget from runtime/comm_accounting.py — the static
+  complement of tools/comm_budget.py's config-level regression guard;
+- ``entry_output_dtypes``: the compiled entry signature's result dtypes,
+  for pinning boundary-transfer payload dtypes (pipeline activations
+  must cross stages in the compute dtype).
+
+Wired as tier-1 tests in tests/unit/test_hlo_contracts.py; deterministic
+on the CPU mesh — no accelerator needed.
+"""
+import re
+from typing import List, NamedTuple, Optional
+
+DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8e4m3fn": 1,
+               "f8e5m2": 1, "s64": 8, "u64": 8, "s32": 4, "u32": 4,
+               "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1}
+
+COLLECTIVE_OPS = ("all-reduce", "all-to-all", "all-gather", "reduce-scatter",
+                  "collective-permute")
+
+# custom-call targets that are host round-trips in disguise
+_HOST_CALLBACK_TARGETS = ("callback", "python_cpu")
+_HOST_OPS_RE = re.compile(r"\b(infeed|outfeed)(\.\d+)?\(")
+_CUSTOM_CALL_RE = re.compile(r"custom-call(\.\d+)?\(.*custom_call_target="
+                             r"\"([^\"]+)\"")
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+class CollectiveOp(NamedTuple):
+    op: str
+    dtype: str
+    elements: int
+    bytes: int
+    line: str
+
+
+class HloContractError(AssertionError):
+    """An HLO contract violation, with the offending HLO lines attached."""
+
+
+def _shape_elements(dims: str) -> int:
+    n = 1
+    for d in dims.split(","):
+        if d:
+            n *= int(d)
+    return n
+
+
+def collective_ops(hlo_text: str) -> List[CollectiveOp]:
+    """Every collective in the HLO with its OUTPUT payload per dtype.
+
+    Same parse discipline as tests/unit/test_onebit.py::_collective_bytes
+    (tuple outputs enumerate each element; get-tuple-element references
+    are not collectives), kept here as the shared library version.
+    """
+    out = []
+    op_re = re.compile(r"=\s*(\(?[^()=]*\)?)\s*(" + "|".join(COLLECTIVE_OPS)
+                       + r")(-start)?(\.\d+)?\(")
+    for line in hlo_text.splitlines():
+        m = op_re.search(line)
+        if not m or line.lstrip().startswith("ROOT %get") \
+                or "get-tuple-element(" in line:
+            continue
+        for dtype, dims in _SHAPE_RE.findall(m.group(1)):
+            n = _shape_elements(dims)
+            out.append(CollectiveOp(
+                op=m.group(2), dtype=dtype, elements=n,
+                bytes=n * DTYPE_BYTES.get(dtype, 4), line=line.strip()))
+    return out
+
+
+def collective_bytes(hlo_text: str) -> int:
+    return sum(c.bytes for c in collective_ops(hlo_text))
+
+
+def host_transfer_ops(hlo_text: str) -> List[str]:
+    """HLO lines that move data host<->device mid-program."""
+    hits = []
+    for line in hlo_text.splitlines():
+        if _HOST_OPS_RE.search(line):
+            hits.append(line.strip())
+            continue
+        m = _CUSTOM_CALL_RE.search(line)
+        if m and any(t in m.group(2).lower()
+                     for t in _HOST_CALLBACK_TARGETS):
+            hits.append(line.strip())
+    return hits
+
+
+def assert_no_host_transfers(hlo_text: str, what: str = "jit") -> None:
+    hits = host_transfer_ops(hlo_text)
+    if hits:
+        raise HloContractError(
+            f"HLO contract: {what} must not transfer to the host mid-"
+            f"program, but the compiled module contains "
+            f"{len(hits)} host-transfer op(s):\n  " + "\n  ".join(hits[:5]))
+
+
+def fp32_collectives(hlo_text: str,
+                     min_elements: int = 0) -> List[CollectiveOp]:
+    return [c for c in collective_ops(hlo_text)
+            if c.dtype in ("f32", "f64") and c.elements >= min_elements]
+
+
+def assert_no_fp32_collectives(hlo_text: str, min_elements: int,
+                               what: str = "jit") -> None:
+    """No fp32 collective moving >= min_elements survives: the declared
+    low-precision wire (bf16 activations, int8+scales gradients) must not
+    have been silently upcast.  Small fp32 payloads (per-block scales,
+    scalar reductions) pass by construction via ``min_elements``."""
+    hits = fp32_collectives(hlo_text, min_elements)
+    if hits:
+        lines = "\n  ".join(c.line for c in hits[:5])
+        raise HloContractError(
+            f"HLO contract: {what} declares a sub-fp32 wire but the "
+            f"compiled module moves fp32 payloads of "
+            f"{[c.elements for c in hits]} elements through "
+            f"collectives:\n  {lines}")
+
+
+def assert_collective_budget(hlo_text: str, budget_bytes: int,
+                             what: str = "jit",
+                             slack: float = 1.0) -> int:
+    """Total collective payload <= budget_bytes * slack.  Returns the
+    measured total so tests can additionally pin ratios.  The budget
+    comes from runtime/comm_accounting.py's analytic per-step numbers
+    (HLO counts OUTPUT bytes; ring-factor send bytes are never larger,
+    so an analytic budget in output terms upper-bounds the wire)."""
+    total = collective_bytes(hlo_text)
+    allowed = int(budget_bytes * slack)
+    if total > allowed:
+        ops = "\n  ".join(c.line for c in collective_ops(hlo_text)[:8])
+        raise HloContractError(
+            f"HLO contract: {what} moves {total} collective bytes, over "
+            f"the analytic budget {budget_bytes} (x{slack} slack = "
+            f"{allowed}); unbudgeted collective sneaked in?\n  {ops}")
+    return total
+
+
+def entry_output_dtypes(hlo_text: str) -> Optional[List[str]]:
+    """Result dtypes of the module's ENTRY computation, or None when no
+    ENTRY signature line is found (HLO text format drift)."""
+    for line in hlo_text.splitlines():
+        m = re.search(r"^ENTRY\s+[^(]*\([^)]*\)\s*->\s*(.+?)\s*{?\s*$", line)
+        if m:
+            return [dtype for dtype, _ in _SHAPE_RE.findall(m.group(1))] \
+                or re.findall(r"(\w+)\[", m.group(1))
+    return None

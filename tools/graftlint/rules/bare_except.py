@@ -1,0 +1,82 @@
+"""Rule ``bare-except``: exception handlers that hide corruption.
+
+Folded in from tools/check_no_bare_except.py (which remains as a thin
+shim over this module).  Flags:
+
+- bare ``except:`` — catches SystemExit/KeyboardInterrupt and turns a
+  preempted checkpoint write into a silently-truncated file;
+- ``except Exception`` / ``except BaseException`` whose body is only
+  ``pass``/``...`` — the error is swallowed with no log, no re-raise, no
+  fallback.
+
+A handler may opt out with a trailing ``# lint: allow-broad-except``
+comment (the legacy marker, still honored) or the standard
+``# graftlint: disable=bare-except``.
+"""
+import ast
+
+from ..core import Finding, Rule, register
+
+ALLOW_MARK = "lint: allow-broad-except"
+BROAD_NAMES = {"Exception", "BaseException"}
+
+
+def _is_broad(handler_type):
+    return (isinstance(handler_type, ast.Name)
+            and handler_type.id in BROAD_NAMES)
+
+
+def _body_is_silent(body):
+    """True when the handler body cannot surface the error: only pass/... ."""
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Constant) and \
+                stmt.value.value is Ellipsis:
+            continue
+        return False
+    return True
+
+
+def check_source(source, filename="<string>"):
+    """Legacy entrypoint: [(lineno, message)] violations for one file.
+
+    Kept bit-compatible with tools/check_no_bare_except.check_source so
+    existing callers (tests/unit/test_lint_guards.py, scripts) keep
+    working through the shim.
+    """
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"syntax error: {e.msg}")]
+    lines = source.splitlines()
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if ALLOW_MARK in line:
+            continue
+        if node.type is None:
+            out.append((node.lineno,
+                        "bare 'except:' (catches KeyboardInterrupt/"
+                        "SystemExit; name the exceptions)"))
+        elif _is_broad(node.type) and _body_is_silent(node.body):
+            out.append((node.lineno,
+                        f"'except {node.type.id}: pass' silently swallows "
+                        f"errors (log, re-raise, or narrow it)"))
+    return sorted(out)
+
+
+@register
+class BareExceptRule(Rule):
+    name = "bare-except"
+    description = ("bare 'except:' or silent 'except Exception: pass' — "
+                   "handlers that hide corruption")
+
+    def check(self, tree, source, path):
+        # reuse the legacy text-level checker so the ALLOW_MARK opt-out
+        # keeps its exact semantics (trailing comment on the except line)
+        return [Finding(rule=self.name, path=path, line=lineno, message=msg)
+                for lineno, msg in check_source(source, path)]

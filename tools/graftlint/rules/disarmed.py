@@ -1,0 +1,66 @@
+"""Rule ``disarmed-discipline``: config-gated optimizations must warn
+when they silently turn themselves off.
+
+The repo's contract (OneBitAdam wire arming, qgZ/qwZ arming,
+PipelineEngine._arm_schedule): an optimization the user ASKED FOR that
+cannot run must emit a warning containing the word ``DISARMED`` naming
+every blocker — "fast as the hardware allows" dies quietly when a knob
+no-ops without a trace.
+
+Statically checkable convention: arming decisions live in functions that
+either are named ``_arm_*`` or assign a ``*_armed`` attribute.  Such a
+function must contain at least one string literal (f-strings included)
+with the word ``DISARMED`` — the warning path.  A new gated optimization
+that follows the naming convention is therefore machine-checked; one
+that dodges the convention dodges the check, so reviewers hold the
+naming line.
+
+The rule fires on the function definition line: the fix is adding the
+warning branch, not touching a particular statement.
+"""
+import ast
+import re
+
+from ..core import Finding, Rule, register, string_constants
+
+ARMED_ATTR_RE = re.compile(r".*_armed$")
+
+
+def _assigns_armed_attr(fn):
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Attribute) and ARMED_ATTR_RE.match(t.attr):
+                    return True
+        if isinstance(n, ast.AugAssign) and isinstance(n.target, ast.Attribute) \
+                and ARMED_ATTR_RE.match(n.target.attr):
+            return True
+    return False
+
+
+@register
+class DisarmedDisciplineRule(Rule):
+    name = "disarmed-discipline"
+    description = ("arming function (_arm_* / *_armed assignment) without "
+                   "a DISARMED warning path — a blocked optimization must "
+                   "name its blockers")
+    scopes = ("deepspeed_tpu",)
+
+    def check(self, tree, source, path):
+        findings = []
+        for n in ast.walk(tree):
+            if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not (n.name.startswith("_arm_") or _assigns_armed_attr(n)):
+                continue
+            if any("DISARMED" in s for s in string_constants(n)):
+                continue
+            findings.append(Finding(
+                rule=self.name, path=path, line=n.lineno,
+                message=(
+                    f"{n.name}() makes an arming decision (name/_armed "
+                    f"attribute) but has no DISARMED warning path; when "
+                    f"the optimization cannot run, warn loudly naming "
+                    f"every blocker (see OneBitAdam/qgZ arming in "
+                    f"runtime/engine.py)")))
+        return findings

@@ -1,0 +1,130 @@
+"""Rule ``donated-state``: donated-buffer references held across a step.
+
+Since PR 2 the engine's micro/apply/fused jits DONATE the input
+TrainState: after the next forward/step call, device buffers previously
+reachable through ``engine.state`` (or a pipeline ``stage_states`` entry)
+are deleted, and touching a held reference raises
+"Array has been deleted" — at a distance, on whichever line happens to
+read it first.  The hazard is the ALIAS, not the attribute: re-reading
+``engine.state.<leaf>`` after the step returns the fresh state and is
+fine.
+
+The pass is a line-ordered dataflow approximation over each function
+body:
+
+1. a variable bound to an expression reading ``.state`` / ``.stage_states``
+   starts being tracked, UNLESS the binding materializes to host first
+   (``jax.device_get`` / ``np.asarray`` / ``np.array`` / ``float`` / ...)
+   — a host copy survives donation;
+2. a later call to a step-like method (forward/backward/step/
+   train_batch/eval_batch/...) is the donation event;
+3. any read of a tracked variable after a donation event that follows
+   its binding is flagged at the use site.
+
+Rebinding a tracked name stops tracking from that line on.  Control flow
+is approximated by line order (a use inside an earlier-line loop body
+that straddles a step call can be missed); the rule is tuned to catch
+the bug class PR 2's hardening fixed by hand, not to be a full alias
+analysis.
+"""
+import ast
+
+from ..core import Finding, Rule, call_name, register, walk_function_bodies
+
+STATE_ATTRS = {"state", "stage_states"}
+STEP_CALLS = {"forward", "backward", "step", "train_batch", "eval_batch",
+              "_take_model_step", "_take_model_step_offload"}
+# calls that copy device data to host (or produce a host scalar): an alias
+# materialized through one of these survives donation
+MATERIALIZERS = {"device_get", "asarray", "array", "copy", "deepcopy",
+                 "float", "int", "bool", "tolist", "item", "num_params"}
+
+
+def _reads_state(node):
+    return any(isinstance(n, ast.Attribute) and n.attr in STATE_ATTRS
+               and isinstance(n.ctx, ast.Load)
+               for n in ast.walk(node))
+
+
+def _materialized(node):
+    return any(isinstance(n, ast.Call) and call_name(n) in MATERIALIZERS
+               for n in ast.walk(node))
+
+
+def _own_nodes(fn):
+    """All AST nodes of ``fn`` excluding nested function/class subtrees
+    (those get their own independent analysis)."""
+    todo = list(ast.iter_child_nodes(fn))
+    while todo:
+        n = todo.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        yield n
+        todo.extend(ast.iter_child_nodes(n))
+
+
+def _name_targets(target):
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for el in target.elts:
+            yield from _name_targets(el)
+
+
+@register
+class DonatedStateRule(Rule):
+    name = "donated-state"
+    description = ("reference to engine.state / stage_states leaves held "
+                   "across a donating step call (use-after-free: 'Array "
+                   "has been deleted')")
+    scopes = ("deepspeed_tpu", "tests")
+
+    def check(self, tree, source, path):
+        findings = []
+        for fn in walk_function_bodies(tree):
+            findings.extend(self._check_function(fn, path))
+        return findings
+
+    def _check_function(self, fn, path):
+        events = []   # (line, order, kind, payload); binds sort first
+        uses = []     # (line, var)
+        for n in _own_nodes(fn):
+            if isinstance(n, ast.Assign):
+                kind = "bind" if _reads_state(n.value) \
+                    and not _materialized(n.value) else "rebind"
+                for t in n.targets:
+                    for name in _name_targets(t):
+                        events.append((n.lineno, 0 if kind == "bind" else 1,
+                                       kind, name))
+            if isinstance(n, ast.Call) and call_name(n) in STEP_CALLS:
+                events.append((n.lineno, 2, "step", None))
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                uses.append((n.lineno, n.id))
+        events.sort()
+
+        findings = []
+        flagged = set()
+        for use_line, var in uses:
+            bind_line = None
+            for line, _, kind, payload in events:
+                if line >= use_line:
+                    break
+                if payload == var:
+                    bind_line = line if kind == "bind" else None
+            if bind_line is None:
+                continue
+            if any(kind == "step" and bind_line < line < use_line
+                   for line, _, kind, _ in events) \
+                    and (var, use_line) not in flagged:
+                flagged.add((var, use_line))
+                findings.append(Finding(
+                    rule=self.name, path=path, line=use_line,
+                    message=(
+                        f"'{var}' holds a reference into a donated train "
+                        f"state (bound from .state/.stage_states at line "
+                        f"{bind_line}) and is read after a step call "
+                        f"donated those buffers; jax.device_get it at the "
+                        f"binding or re-read engine.state after the "
+                        f"step")))
+        return findings

@@ -1,0 +1,185 @@
+"""Rule ``host-sync``: device round-trips where they stall the pipeline.
+
+Two contexts, two severities of wrong:
+
+**Traced functions** (anything jit- or shard_map-traced): a host sync on
+a tracer either crashes at trace time (``float``/``.item()``) or — worse
+— silently forces a transfer per call (``np.asarray`` on a concrete
+array closed over the trace).  Flagged calls: ``.item()``,
+``.block_until_ready()``, ``jax.device_get``, ``np.asarray``/``np.array``.
+Traced functions are discovered by:
+
+- Name/lambda arguments to ``jax.jit`` / ``jit`` / ``jax.shard_map`` /
+  ``shard_map`` (incl. ``partial(jax.jit, ...)``) and ``@jit`` decorators;
+- the repo idiom: every function DEFINED INSIDE a ``_make_*`` factory is
+  trace-bound (the engine builds its jitted steps that way).
+
+**Hot host loops**: in the engine files' step-driving methods
+(train_batch / eval_batch / the schedule interpreters) and in benchmark
+timed regions, a ``jax.device_get`` / ``.item()`` /
+``.block_until_ready()`` INSIDE a Python loop serializes the device
+against the host once per iteration — the async-dispatch overlap the
+schedules depend on dies quietly.  The fix idiom: dispatch inside the
+loop, fetch ONCE after it (``jax.device_get`` on the collected list), as
+train_batch's loss reduction does.
+
+``float()``/``int()`` and ``np.asarray`` are NOT flagged in host loops —
+host-side math on host data is legitimate there; only true device syncs
+are.
+"""
+import ast
+import re
+
+from ..core import Finding, Rule, call_name, register
+
+# files whose step-driving loops are hot paths (repo-relative)
+HOT_FILES = {
+    "deepspeed_tpu/runtime/engine.py",
+    "deepspeed_tpu/runtime/pipe/engine.py",
+}
+HOT_FN_RE = re.compile(
+    r"^(train_batch|eval_batch|forward|backward|step"
+    r"|_take_model_step\w*|_exec_\w+|_run_\w+)$")
+# benchmark drivers: every loop is (or brackets) a timed region — a sync
+# per iteration pollutes the measured step time with transfer latency
+BENCH_FILES = {"bench.py", "tools/pipe_bench.py"}
+
+SYNC_METHOD_ATTRS = {"item", "block_until_ready"}
+SYNC_FN_NAMES = {"device_get", "block_until_ready"}
+NP_MATERIALIZERS = {"asarray", "array"}
+NP_MODULES = {"np", "numpy", "onp"}
+TRACE_WRAPPERS = {"jit", "shard_map", "pmap"}
+LOOP_NODES = (ast.For, ast.AsyncFor, ast.While, ast.ListComp, ast.SetComp,
+              ast.DictComp, ast.GeneratorExp)
+
+
+def _attr_root_module(node):
+    """'np' for np.asarray, 'jax' for jax.device_get, None otherwise."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return node.value.id
+    return None
+
+
+def _is_trace_wrapper(func):
+    """True for jax.jit / jit / jax.shard_map / shard_map (as a call
+    target), including partial(jax.jit, ...)."""
+    name = call_name(func) if not isinstance(func, ast.Call) else None
+    if name in TRACE_WRAPPERS:
+        return True
+    # partial(jax.jit, ...) used as decorator or wrapper
+    if isinstance(func, ast.Call) and call_name(func) == "partial" \
+            and func.args and call_name(func.args[0]) in TRACE_WRAPPERS:
+        return True
+    return False
+
+
+def _collect_traced_nodes(tree):
+    """Function/Lambda nodes whose bodies execute under a jax trace."""
+    defs_by_name = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(n.name, []).append(n)
+
+    traced = []
+    for n in ast.walk(tree):
+        # jax.jit(fn, ...) / shard_map(fn, ...) with a Name or Lambda arg
+        if isinstance(n, ast.Call) and _is_trace_wrapper(n.func) and n.args:
+            target = n.args[0]
+            if isinstance(target, ast.Lambda):
+                traced.append(target)
+            elif isinstance(target, ast.Name):
+                traced.extend(defs_by_name.get(target.id, []))
+        # decorators: @jax.jit / @jit / @partial(jax.jit, ...)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_trace_wrapper(dec) for dec in n.decorator_list):
+                traced.append(n)
+            # repo idiom: functions defined inside a _make_* factory are
+            # the jit-traced step bodies
+            if n.name.startswith("_make_"):
+                for sub in ast.walk(n):
+                    if sub is not n and isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                        traced.append(sub)
+    return traced
+
+
+def _sync_calls(tree, include_np):
+    """(node, what) for host-sync calls in a subtree."""
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        func = n.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in SYNC_METHOD_ATTRS and not n.args:
+                yield n, f".{func.attr}()"
+                continue
+            root = _attr_root_module(func)
+            if func.attr in SYNC_FN_NAMES and root in {"jax", None}:
+                yield n, f"jax.{func.attr}"
+                continue
+            if include_np and func.attr in NP_MATERIALIZERS \
+                    and root in NP_MODULES:
+                yield n, f"{root}.{func.attr}"
+        elif isinstance(func, ast.Name) and func.id in SYNC_FN_NAMES:
+            yield n, func.id
+
+
+@register
+class HostSyncRule(Rule):
+    name = "host-sync"
+    description = ("host↔device sync (.item()/.block_until_ready()/"
+                   "jax.device_get/np.asarray) inside a traced function "
+                   "or a hot per-micro loop")
+
+    def check(self, tree, source, path):
+        findings = []
+        seen = set()
+
+        def add(node, what, ctx):
+            key = (node.lineno, getattr(node, "col_offset", 0))
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(Finding(
+                rule=self.name, path=path, line=node.lineno,
+                col=getattr(node, "col_offset", 0),
+                message=f"{what} {ctx}"))
+
+        # --- traced-function context (any file) ------------------------
+        for fn in _collect_traced_nodes(tree):
+            for node, what in _sync_calls(fn, include_np=True):
+                add(node, what,
+                    "inside a jit/shard_map-traced function — this either "
+                    "fails on a tracer or forces a per-call device sync; "
+                    "move it outside the traced body")
+
+        # --- hot-loop context (engine step paths + bench timed regions) -
+        if path in HOT_FILES or path in BENCH_FILES:
+            hot_fns = []
+            for n in ast.walk(tree):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and (path in BENCH_FILES
+                             or HOT_FN_RE.match(n.name)):
+                    hot_fns.append(n)
+            for fn in hot_fns:
+                for n in ast.walk(fn):
+                    if not isinstance(n, LOOP_NODES):
+                        continue
+                    bodies = []
+                    if isinstance(n, (ast.For, ast.AsyncFor, ast.While)):
+                        bodies.extend(n.body)
+                    else:  # comprehensions: the element/key/value exprs
+                        for name in ("elt", "key", "value"):
+                            sub = getattr(n, name, None)
+                            if sub is not None:
+                                bodies.append(sub)
+                    for b in bodies:
+                        for node, what in _sync_calls(b, include_np=False):
+                            add(node, what,
+                                f"inside a per-iteration loop in "
+                                f"{fn.name}() — one device round-trip per "
+                                f"iteration; dispatch in the loop and "
+                                f"fetch once after it (jax.device_get on "
+                                f"the collected list)")
+        return findings

@@ -1,0 +1,76 @@
+"""Rule ``rank-branch-collective``: collectives guarded by rank identity.
+
+A collective only completes when EVERY participant reaches it.  A Python
+branch on ``axis_index`` / ``process_index`` makes control flow
+rank-dependent; a collective under either arm of such a branch is a
+static deadlock: ranks that take the other arm never post the matching
+collective and the job wedges (on real multi-host TPU — on the
+single-process test mesh shard_map traces both "arms" and the hazard
+hides until production).  This complements the DYNAMIC queue-replay
+deadlock detection in runtime/pipe/bubble_accounting.py: that one proves
+a compiled schedule's send/recv streams can drain; this one catches the
+SPMD-side divergence no schedule replay can see.
+
+``process_count()`` / ``axis_size`` guards are uniform (every rank
+computes the same truth value) and are deliberately not flagged.
+
+Both host-level coordination collectives (multihost_utils.*,
+resilience/coordination.py's all_agree/broadcast_tag) and in-program
+collectives (lax.psum & friends, the custom quantized collectives) are
+matched — a rank-gated host barrier deadlocks exactly the same way.
+
+Rank-dependent VALUES are fine; express them with ``jnp.where`` /
+``lax.cond`` on data, keeping the collective itself unconditional.
+"""
+import ast
+
+from ..core import Finding, Rule, call_name, contains_call_to, register
+
+RANK_FNS = {"axis_index", "process_index"}
+COLLECTIVES = {
+    # jax.lax in-program collectives
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "psum_scatter",
+    # repo custom collectives
+    "quantized_reduce_scatter", "onebit_allreduce",
+    # host-level coordination barriers
+    "process_allgather", "broadcast_one_to_all", "sync_global_devices",
+    "all_agree", "broadcast_tag",
+}
+
+
+def _test_is_rank_dependent(test):
+    return contains_call_to(test, RANK_FNS)
+
+
+@register
+class RankBranchCollectiveRule(Rule):
+    name = "rank-branch-collective"
+    description = ("collective inside a Python branch on axis_index/"
+                   "process_index — non-uniform control flow deadlocks "
+                   "SPMD programs")
+    scopes = ("deepspeed_tpu", "tests")
+
+    def check(self, tree, source, path):
+        findings = []
+        for n in ast.walk(tree):
+            if not isinstance(n, (ast.If, ast.While)):
+                continue
+            if not _test_is_rank_dependent(n.test):
+                continue
+            arms = list(n.body) + list(getattr(n, "orelse", []))
+            for stmt in arms:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call) \
+                            and call_name(sub) in COLLECTIVES:
+                        findings.append(Finding(
+                            rule=self.name, path=path, line=sub.lineno,
+                            message=(
+                                f"collective '{call_name(sub)}' under a "
+                                f"branch on {'/'.join(sorted(RANK_FNS))} "
+                                f"(line {n.lineno}): ranks taking the "
+                                f"other arm never post it and the program "
+                                f"deadlocks; run the collective on every "
+                                f"rank and select the VALUE by rank "
+                                f"(jnp.where) instead")))
+        return findings

@@ -40,6 +40,29 @@ def _block_params(params, cfg):
     return [params[f"h_{i}"] for i in range(cfg.n_layer)]
 
 
+def _split_heads(t, B, T, H, D):
+    return t.reshape(B, T, H, D).transpose(0, 2, 1, 3)  # (B, H, T, D)
+
+
+def _attn_core(q, keys, values, valid, p, out_dtype):
+    """Masked attention shared by every decode surface: the contiguous
+    KV cache here, the causal prefill, and the serving engine's paged
+    pool (deepspeed_tpu/serving/engine.py).  q/keys/values: (B, H, Q, D)
+    and (B, H, K, D); ``valid`` broadcasts against the (B, H, Q, K)
+    score tensor.  Scores accumulate in f32 and masked positions score
+    -1e30, which softmax turns into EXACT zeros — so a path that gathers
+    a wider, padded key view (the paged pool) produces bit-identical
+    outputs to one that attends a tight contiguous cache."""
+    B, H, Q, D = q.shape
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, keys,
+                   preferred_element_type=jnp.float32) * (D ** -0.5)
+    s = jnp.where(valid, s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1).astype(out_dtype)
+    y = jnp.einsum("bhqk,bhkd->bhqd", probs, values)   # (B, H, Q, D)
+    y = y.transpose(0, 2, 1, 3).reshape(B, Q, H * D)
+    return _dense(y, p["c_proj"])
+
+
 def _attn_decode(x, p, cache_k, cache_v, pos, cfg):
     """One-token attention against the cache. x: (B, 1, E); cache_k/v:
     (B, H, S_max, D); pos: scalar int32 current position."""
@@ -47,22 +70,14 @@ def _attn_decode(x, p, cache_k, cache_v, pos, cfg):
     H, D = cfg.n_head, cfg.head_dim
     qkv = _dense(x, p["c_attn"])                       # (B, 1, 3E)
     q, k, v = jnp.split(qkv, 3, axis=-1)
-
-    def heads(t):
-        return t.reshape(B, 1, H, D).transpose(0, 2, 1, 3)  # (B, H, 1, D)
-
-    q, k, v = heads(q), heads(k), heads(v)
+    q, k, v = (_split_heads(t, B, 1, H, D) for t in (q, k, v))
     cache_k = jax.lax.dynamic_update_slice(cache_k, k, (0, 0, pos, 0))
     cache_v = jax.lax.dynamic_update_slice(cache_v, v, (0, 0, pos, 0))
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, cache_k,
-                   preferred_element_type=jnp.float32) * (D ** -0.5)
     # mask out the not-yet-written tail of the cache
     valid = jnp.arange(cache_k.shape[2]) <= pos        # (S_max,)
-    s = jnp.where(valid[None, None, None, :], s, -1e30)
-    probs = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-    y = jnp.einsum("bhqk,bhkd->bhqd", probs, cache_v)  # (B, H, 1, D)
-    y = y.transpose(0, 2, 1, 3).reshape(B, 1, cfg.n_embd)
-    return _dense(y, p["c_proj"]), cache_k, cache_v
+    out = _attn_core(q, cache_k, cache_v, valid[None, None, None, :], p,
+                     x.dtype)
+    return out, cache_k, cache_v
 
 
 def _moe_ffn(x, mp, cfg):
@@ -130,19 +145,19 @@ def _attn_prefill(x, p, cfg):
     H, D = cfg.n_head, cfg.head_dim
     qkv = _dense(x, p["c_attn"])
     q, k, v = jnp.split(qkv, 3, axis=-1)
-
-    def heads(t):
-        return t.reshape(B, S, H, D).transpose(0, 2, 1, 3)
-
-    q, k, v = heads(q), heads(k), heads(v)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * (D ** -0.5)
+    q, k, v = (_split_heads(t, B, S, H, D) for t in (q, k, v))
     mask = jnp.tril(jnp.ones((S, S), bool))
-    s = jnp.where(mask[None, None], s, -1e30)
-    probs = jax.nn.softmax(s, axis=-1).astype(x.dtype)
-    y = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
-    y = y.transpose(0, 2, 1, 3).reshape(B, S, E)
-    return _dense(y, p["c_proj"]), k, v
+    return _attn_core(q, k, v, mask[None, None], p, x.dtype), k, v
+
+
+def _lm_logits(params, cfg, xe):
+    """Tied LM head over (B, E) final hidden states, MXU-alignment pad
+    columns dropped so sampling never picks a pad id.  Shared by the
+    prompt prefill, single-token decode, and the serving engine's paged
+    decode/prefill (deepspeed_tpu/serving/engine.py) — one head, one
+    dtype policy, bit-identical logits across every decode surface."""
+    logits = jnp.einsum("be,ve->bv", xe, params["wte"].astype(cfg.dtype))
+    return logits[:, :cfg.vocab_size].astype(jnp.float32)
 
 
 def _prefill(params, cfg, tokens):
@@ -161,11 +176,7 @@ def _prefill(params, cfg, tokens):
         ks.append(k)
         vs.append(v)
     x = _ln(x, params["ln_f"], cfg.layer_norm_epsilon)
-    logits = jnp.einsum("be,ve->bv", x[:, -1],
-                        params["wte"].astype(cfg.dtype))
-    # drop MXU-alignment pad columns so sampling never picks a pad id
-    return logits[:, :cfg.vocab_size].astype(jnp.float32), \
-        jnp.stack(ks), jnp.stack(vs)
+    return _lm_logits(params, cfg, x[:, -1]), jnp.stack(ks), jnp.stack(vs)
 
 
 def _forward_token(params, cfg, token, pos, caches_k, caches_v):
@@ -182,8 +193,7 @@ def _forward_token(params, cfg, token, pos, caches_k, caches_v):
         new_k.append(ck)
         new_v.append(cv)
     x = _ln(x, params["ln_f"], cfg.layer_norm_epsilon)
-    logits = jnp.einsum("bse,ve->bsv", x, wte.astype(cfg.dtype))
-    return logits[:, 0, :cfg.vocab_size].astype(jnp.float32), \
+    return _lm_logits(params, cfg, x[:, 0]), \
         jnp.stack(new_k), jnp.stack(new_v)
 
 
@@ -245,6 +255,8 @@ def generate(model, params, input_ids, max_new_tokens: int,
     # a sign/range bug here would otherwise mask EVERY logit and emit
     # plausible-shaped garbage (token 0 forever) with no error
     assert 0.0 <= (top_p or 0.0) <= 1.0, f"top_p must be in [0, 1]: {top_p}"
+    assert top_k is None or top_k >= 0, f"top_k must be >= 0: {top_k}"
+    assert temperature >= 0.0, f"temperature must be >= 0: {temperature}"
     if num_beams > 1:
         assert temperature == 0.0 and not top_k and not top_p \
             and rng is None, \

@@ -339,6 +339,47 @@ def pipe_p2p_bytes(act_bytes_per_edge: Sequence[int],
     return per_micro * int(micro_batches)
 
 
+def serving_decode_collectives(
+        n_layer: int, n_embd: int, vocab_size: int, batch: int, *,
+        tp: int = 1, act_dtype: str = "float32") -> List[Collective]:
+    """Collectives of ONE continuous-batching decode step
+    (deepspeed_tpu/serving/engine.py), per placement.
+
+    **Batch-axis sharding** (the serving engine's shard_map layout,
+    ``tp == 1``): slots, page tables, token/position vectors and the KV
+    block pool are all split on the same mesh axis with params
+    replicated.  Under the placement-semantics analysis of PAPERS.md
+    (arXiv 2601.02311) every operator in the decode program carries the
+    slot axis as a free (uniform) dimension — no operator contracts over
+    it — so the induced resharding set is EMPTY: the step moves zero
+    collective bytes, and tests/unit/test_hlo_contracts.py pins the
+    compiled program to exactly that.  Returns [].
+
+    **Tensor (model-axis) sharding** (``tp > 1``, the classic
+    DeepSpeed-Inference kernel-injection layout): qkv/attn-out and
+    mlp-in/mlp-out GEMM pairs are column/row split, so each layer
+    all-reduces its (batch, 1, n_embd) activation twice per token, plus
+    one all-reduce of the (batch, vocab) logits — the per-token latency
+    tax batch sharding avoids, priced here for comm_budgets.json."""
+    if tp <= 1:
+        return []
+    es = DTYPE_BYTES[act_dtype]
+    out: List[Collective] = []
+    act = batch * n_embd
+    for layer in range(n_layer):
+        for which in ("attn_out", "mlp_out"):
+            out.append(Collective(
+                name=f"decode_ar:{which}:l{layer}", op="all-reduce",
+                dtype=act_dtype, elements=act, axis_size=tp,
+                bytes_per_device=allreduce_bytes(act, es, tp)))
+    n_logits = batch * vocab_size
+    out.append(Collective(
+        name="decode_ar:logits", op="all-reduce", dtype="float32",
+        elements=n_logits, axis_size=tp,
+        bytes_per_device=allreduce_bytes(n_logits, 4, tp)))
+    return out
+
+
 def zero_shard_dim(shape: Sequence[int], dp: int,
                    taken: Sequence[int] = ()) -> Optional[int]:
     """The dimension mesh.zero_merge_spec would shard over 'data': the

@@ -24,12 +24,13 @@ class ChaosPlan:
 
     def __init__(self, kill_after_files=None, kill_at_point=None,
                  corrupt_after_files=None, corrupt_nbytes=4,
-                 nan_grad_steps=0):
+                 nan_grad_steps=0, cancel_request_every=0):
         self.kill_after_files = kill_after_files
         self.kill_at_point = kill_at_point
         self.corrupt_after_files = corrupt_after_files
         self.corrupt_nbytes = corrupt_nbytes
         self.nan_grad_steps = nan_grad_steps
+        self.cancel_request_every = cancel_request_every
         self.files_written = 0
         self.fired = []
         self._lock = threading.Lock()
@@ -49,6 +50,9 @@ def arm(**kwargs):
                          corruption; the manifest checksum must catch it).
     nan_grad_steps=K     poison the gradient accumulator with NaN for the
                          next K optimizer steps (drives overflow/NaN streaks).
+    cancel_request_every=N  have the serving scheduler cancel its youngest
+                         running request every Nth step (request-churn
+                         chaos for the continuous-batching engine).
     """
     global _plan
     _plan = ChaosPlan(**kwargs)
@@ -99,6 +103,25 @@ def point(name):
     if _plan is not None and _plan.kill_at_point == name:
         _plan.fired.append(("kill_at_point", name))
         raise ChaosInterrupt(f"chaos: killed checkpoint commit at {name!r}")
+
+
+def serving_cancel_request(step_index):
+    """True when an armed plan wants the serving scheduler to cancel a
+    running request at this (1-based) scheduler step — the request-churn
+    analog of nan_grad_steps, driven through the user-facing cancel path
+    (deepspeed_tpu/serving/scheduler.py::Scheduler.chaos_cancel).  Pure
+    query: the scheduler records via record_serving_cancel only when a
+    victim actually exists, so ``fired`` audits real cancellations."""
+    if _plan is None or not _plan.cancel_request_every:
+        return False
+    return step_index % _plan.cancel_request_every == 0
+
+
+def record_serving_cancel(rid):
+    """Audit one ACTUAL chaos-driven request cancellation."""
+    if _plan is not None:
+        with _plan._lock:
+            _plan.fired.append(("cancel_request", rid))
 
 
 def consume_nan_grad_step():

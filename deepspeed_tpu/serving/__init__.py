@@ -1,0 +1,20 @@
+"""Continuous-batching inference serving (the DeepSpeed-Inference analog).
+
+- :mod:`kv_cache` — paged KV pool: a fixed block pool plus per-sequence
+  page tables, donated into the decode jit and updated in place, with
+  optional int8 storage via runtime/quantization.py;
+- :mod:`scheduler` — token-level continuous batching: admission, chunked
+  prefill, priority classes, eviction and cancellation between steps;
+- :mod:`engine` — :class:`InferenceEngine`: ONE fixed-shape batched
+  decode jit with slot masking (requests joining/leaving never
+  recompile) plus length-bucketed prefill jits;
+- :mod:`metrics` — TTFT / TPOT / throughput / KV-pool occupancy,
+  exposed via ``InferenceEngine.serving_report()``.
+"""
+from deepspeed_tpu.serving.engine import InferenceEngine
+from deepspeed_tpu.serving.kv_cache import PagedKVPool
+from deepspeed_tpu.serving.metrics import CompilationCounter, ServingMetrics
+from deepspeed_tpu.serving.scheduler import Request, Scheduler
+
+__all__ = ["InferenceEngine", "PagedKVPool", "Scheduler", "Request",
+           "ServingMetrics", "CompilationCounter"]

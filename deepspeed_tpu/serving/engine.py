@@ -1,0 +1,599 @@
+"""Continuous-batching inference engine over the paged KV pool.
+
+The compiled surface is deliberately tiny and FIXED-SHAPE:
+
+- ONE decode jit over all ``max_slots`` lanes, with per-slot page
+  tables, positions and an active mask — requests joining, leaving,
+  finishing or being evicted only change ARRAY CONTENTS, never shapes,
+  so steady-state serving triggers zero recompilations (pinned by the
+  CompilationCounter acceptance test);
+- a small family of length-bucketed chunked-prefill jits (one per
+  power-of-two bucket x final/non-final), so a long prompt is absorbed
+  ``prefill_chunk`` tokens per step between decode steps and never
+  stalls running decodes.
+
+Both programs DONATE the pool tensors (kv_cache.PoolTensors) and update
+them in place: steady-state decode is allocation-free, and the HLO
+contracts in tests/unit/test_hlo_contracts.py pin the decode jit to
+"host-transfer-free + pool donated + (sharded) zero collective bytes".
+
+The decode math reuses models/generation.py internals (``_attn_core``,
+``_ln``, ``_ffn``, ``_sample``) over a gathered page view, and the exact
+-1e30 masking makes greedy tokens bit-identical to single-sequence
+``generate()`` — under staggered arrivals, eviction and cancellation
+churn (the parity acceptance test).
+
+Sharding: with ``shards > 1`` the decode program runs under a shard_map
+over the slot axis — slots, page tables and the block pool are all split
+on the same mesh axis, params replicated.  Every decode operator is
+batch-uniform in the slot dimension, so the compiled program contains NO
+collectives (runtime/comm_accounting.serving_decode_collectives prices
+this placement against the tensor-parallel alternative).
+"""
+import functools
+import itertools
+import time
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.generation import (_attn_core, _block_params,
+                                             _dense, _ffn, _lm_logits,
+                                             _ln, _sample, _split_heads)
+from deepspeed_tpu.runtime.quantization import (dequantize_rows,
+                                                quantize_rows)
+from deepspeed_tpu.serving.kv_cache import (TRASH_BLOCK, PagedKVPool,
+                                            PoolTensors)
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.scheduler import Request, Scheduler
+from deepspeed_tpu.utils.jax_compat import ensure_compat
+
+ensure_compat()
+
+_MIN_BUCKET = 4
+
+
+def _slot_key(seed, pos):
+    """Per-request sampling key: a function of (request seed, absolute
+    position) only — the token stream of a sampled request does not
+    depend on which slot or step it lands in."""
+    return jax.random.fold_in(jax.random.PRNGKey(seed), pos)
+
+
+def _pool_write(pool, scales, l, blk, off, rows, quantized):
+    """Scatter one K or V row per (lane, head) into the block pool.
+    rows: (N, H, D); blk/off: (N,) local block id / in-block offset.
+    Masked lanes arrive with blk == TRASH_BLOCK and land in the trash
+    block — the scatter itself is always dense."""
+    N, H, D = rows.shape
+    if quantized:
+        q, s = quantize_rows(rows.reshape(N * H, D), block_size=D)
+        pool = pool.at[l, blk, :, off, :].set(q.reshape(N, H, D))
+        scales = scales.at[l, blk, :, off].set(
+            s.reshape(N, H).astype(jnp.float32))
+    else:
+        pool = pool.at[l, blk, :, off, :].set(rows.astype(pool.dtype))
+    return pool, scales
+
+
+def _pool_view(pool, scales, l, tables, quantized, out_dtype):
+    """Gather per-sequence page views back to contiguous position order:
+    (B, W) tables over (L, NB, H, bs, D) pool -> (B, H, W*bs, D).  View
+    position j IS absolute sequence position j, so the attention mask of
+    the contiguous cache applies unchanged."""
+    B, W = tables.shape
+    _, _, H, bs, D = pool.shape
+    g = pool[l][tables.reshape(-1)]
+    g = g.reshape(B, W, H, bs, D).transpose(0, 2, 1, 3, 4) \
+         .reshape(B, H, W * bs, D)
+    if not quantized:
+        return g
+    s = scales[l][tables.reshape(-1)].reshape(B, W, H, bs) \
+        .transpose(0, 2, 1, 3).reshape(B * H * W * bs, 1)
+    return dequantize_rows(g.reshape(B * H * W * bs, D), s, D,
+                           out_dtype).reshape(B, H, W * bs, D)
+
+
+def _paged_forward(params, cfg, pools, tables, pos, blk, off, x,
+                   quantized):
+    """Shared transformer pass of decode and chunked prefill: per layer,
+    write this step's K/V rows into the pool, gather the page view, and
+    run the SAME attention core the contiguous cache uses.  x: (B, T, E)
+    with T == number of query tokens per lane; pos: (B*T?,) absolute
+    positions of the query tokens, flattened to match blk/off."""
+    pk, pv, ksc, vsc = pools
+    B, T, _ = x.shape
+    H, D = cfg.n_head, cfg.head_dim
+    W = tables.shape[1]
+    bs = pk.shape[3]
+    validj = (jnp.arange(W * bs)[None, :] <= pos.reshape(B, T)[:, :, None]) \
+        .reshape(B, T, W * bs)[:, None]                  # (B, 1, T, K)
+    for l, bp in enumerate(_block_params(params, cfg)):
+        h = _ln(x, bp["ln_1"], cfg.layer_norm_epsilon)
+        qkv = _dense(h, bp["attn"]["c_attn"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = _split_heads(q, B, T, H, D)                  # (B, H, T, D)
+        kt = k.reshape(B * T, H, D)
+        vt = v.reshape(B * T, H, D)
+        pk, ksc = _pool_write(pk, ksc, l, blk, off, kt, quantized)
+        pv, vsc = _pool_write(pv, vsc, l, blk, off, vt, quantized)
+        kview = _pool_view(pk, ksc, l, tables, quantized, x.dtype)
+        vview = _pool_view(pv, vsc, l, tables, quantized, x.dtype)
+        a = _attn_core(q, kview, vview, validj, bp["attn"], x.dtype)
+        x = x + a
+        x = x + _ffn(_ln(x, bp["ln_2"], cfg.layer_norm_epsilon), bp, cfg)
+    x = _ln(x, params["ln_f"], cfg.layer_norm_epsilon)
+    return x, (pk, pv, ksc, vsc)
+
+
+def _pick_next(logits, seeds, pos, temperature, top_k, top_p):
+    """Greedy argmax (the bit-parity path) or per-lane sampled token."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    keys = jax.vmap(_slot_key)(seeds, pos)
+    return jax.vmap(
+        lambda lg, k: _sample(lg[None], k, temperature, top_k, top_p)[0]
+    )(logits, keys).astype(jnp.int32)
+
+
+def _shard_wrap(core, mesh, axis_name, n_pool, in_streams, n_out_streams):
+    """jit(shard_map(core)) with pool tensors split on the block axis,
+    per-slot streams split on the slot axis and params replicated; plain
+    jit when mesh is None.  ``in_streams``/``n_out_streams`` mark which
+    trailing args / leading-after-pool outputs carry the slot axis."""
+    donate = tuple(range(1, 1 + n_pool))
+    if mesh is None:
+        return jax.jit(core, donate_argnums=donate)
+    from jax.sharding import PartitionSpec as P
+
+    pool_spec = P(None, axis_name)
+    in_specs = (P(),) + (pool_spec,) * n_pool + tuple(
+        P(axis_name) if s else P() for s in in_streams)
+    out_specs = (pool_spec,) * n_pool + (P(axis_name),) * n_out_streams
+    sm = jax.shard_map(core, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=False)
+    return jax.jit(sm, donate_argnums=donate)
+
+
+@functools.lru_cache(maxsize=64)
+def _make_decode_step(cfg, W, bs, quantized, temperature, top_k, top_p,
+                      mesh, axis_name):
+    """ONE fixed-shape decode program over every (local) slot lane."""
+    def run(params, *args):
+        pools, (tables, pos, tok, active, seeds) = \
+            (args[:4] if quantized else args[:2] + (None, None)), args[-5:]
+        S = tok.shape[0]
+        x = params["wte"].astype(cfg.dtype)[tok][:, None, :] \
+            + params["wpe"].astype(cfg.dtype)[pos][:, None, :]   # (S, 1, E)
+        blk = jnp.where(active, tables[jnp.arange(S), pos // bs],
+                        TRASH_BLOCK)
+        off = pos % bs
+        x, pools = _paged_forward(params, cfg, pools, tables, pos, blk,
+                                  off, x, quantized)
+        logits = _lm_logits(params, cfg, x[:, 0])
+        nxt = _pick_next(logits, seeds, pos, temperature, top_k, top_p)
+        nxt = jnp.where(active, nxt, 0).astype(jnp.int32)
+        out = pools[:4] if quantized else pools[:2]
+        return (*out, nxt)
+
+    n_pool = 4 if quantized else 2
+    return _shard_wrap(run, mesh, axis_name, n_pool,
+                       in_streams=(True,) * 5, n_out_streams=1)
+
+
+@functools.lru_cache(maxsize=256)
+def _make_prefill_chunk(cfg, C, W, bs, quantized, final, temperature,
+                        top_k, top_p, mesh, axis_name):
+    """One prefill chunk of (padded) length C for ONE sequence.  Under
+    sharding every shard executes the chunk against its LOCAL pool with
+    its own table row / n_valid — non-owner shards get n_valid == 0, so
+    their writes all land in the trash block and their (finite) outputs
+    are ignored by the host."""
+    def run(params, *args):
+        pools = args[:4] if quantized else args[:2] + (None, None)
+        table_rows, tokens, start, n_valids, seed = args[-5:]
+        row = table_rows[0]
+        n_valid = n_valids[0]
+        posns = start + jnp.arange(C)                      # (C,)
+        x = params["wte"].astype(cfg.dtype)[tokens][None] \
+            + params["wpe"].astype(cfg.dtype)[posns][None]  # (1, C, E)
+        valid_i = jnp.arange(C) < n_valid
+        blk = jnp.where(valid_i, row[posns // bs], TRASH_BLOCK)
+        off = posns % bs
+        x, pools = _paged_forward(params, cfg, pools, row[None], posns,
+                                  blk, off, x, quantized)
+        out = pools[:4] if quantized else pools[:2]
+        if not final:
+            return out
+        xe = jax.lax.dynamic_index_in_dim(x[0], n_valid - 1, 0,
+                                          keepdims=False)
+        logits = _lm_logits(params, cfg, xe[None])
+        nxt = _pick_next(logits, seed[None], (start + n_valid - 1)[None],
+                         temperature, top_k, top_p)
+        return (*out, nxt)
+
+    n_pool = 4 if quantized else 2
+    return _shard_wrap(run, mesh, axis_name, n_pool,
+                       in_streams=(True, False, False, True, False),
+                       n_out_streams=1 if final else 0)
+
+
+class InferenceEngine:
+    """Continuous-batching serving engine (see module docstring).
+
+    ``temperature``/``top_k``/``top_p`` are ENGINE-static (baked into the
+    compiled programs); per-request randomness comes from each request's
+    ``seed``.  temperature=0 (greedy) is the bit-parity configuration.
+    """
+
+    def __init__(self, model, params, *, max_slots=4, kv_block_size=16,
+                 kv_blocks=None, max_blocks_per_seq=None, prefill_chunk=16,
+                 quantize_kv=False, temperature=0.0, top_k=0, top_p=0.0,
+                 policy="continuous", shards=1, mesh=None,
+                 axis_name="data", watchdog=None, clock=time.monotonic):
+        cfg = model.config
+        assert not getattr(cfg, "moe_num_experts", 0), \
+            "InferenceEngine serves dense blocks only: chunked prefill " \
+            "changes MoE capacity-gating semantics (generation._moe_ffn " \
+            "gates whole prompts); use models.generation.generate for MoE"
+        assert prefill_chunk >= _MIN_BUCKET \
+            and (prefill_chunk & (prefill_chunk - 1)) == 0, \
+            f"prefill_chunk must be a power of two >= {_MIN_BUCKET}"
+        assert max_slots % shards == 0, (max_slots, shards)
+        if mesh is not None:
+            assert shards == mesh.shape[axis_name], \
+                f"shards={shards} != mesh axis {axis_name} size"
+        else:
+            assert shards == 1, "shards > 1 requires a mesh"
+        self.model, self.cfg, self.params = model, cfg, params
+        self.max_slots = int(max_slots)
+        self.shards = int(shards)
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.bs = int(kv_block_size)
+        self.W = int(max_blocks_per_seq
+                     or -(-int(cfg.n_positions) // self.bs))
+        if kv_blocks is None:
+            kv_blocks = shards + max_slots * self.W     # never evicts
+        self.pool = PagedKVPool(cfg, num_blocks=kv_blocks,
+                                block_size=self.bs, shards=shards,
+                                mesh=mesh, axis_name=axis_name,
+                                quantize_kv=quantize_kv)
+        self.prefill_chunk = int(prefill_chunk)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k or 0)
+        self.top_p = float(top_p or 0.0)
+        self.scheduler = Scheduler(max_slots, policy=policy)
+        # admission placement: prefer the slot whose shard has the most
+        # free KV blocks, so new sequences spread across shard pools
+        # instead of piling evictions onto shard 0
+        self.scheduler.slot_ranker = \
+            lambda s: self.pool.free_blocks(self._shard_for_slot(s))
+        self.metrics = ServingMetrics(clock)
+        self.results = {}
+        self._watchdog = watchdog
+        self._last_metrics = {}
+        self._step_idx = 0
+        self._rids = itertools.count()
+        S = self.max_slots
+        self._tables = np.full((S, self.W), TRASH_BLOCK, np.int32)
+        self._pos = np.zeros(S, np.int32)
+        self._tok = np.zeros(S, np.int32)
+        self._active = np.zeros(S, bool)
+        self._seeds = np.zeros(S, np.int32)
+        self._decode = _make_decode_step(
+            cfg, self.W, self.bs, self.pool.quantized, self.temperature,
+            self.top_k, self.top_p, mesh, axis_name)
+
+    # -- public API -----------------------------------------------------
+    @property
+    def capacity_per_seq(self) -> int:
+        """Longest admissible prompt+max_new: the position budget
+        (n_positions), the page-table width, AND one shard's usable
+        block pool all bound it."""
+        return min(int(self.cfg.n_positions), self.W * self.bs,
+                   (self.pool.blocks_per_shard - 1) * self.bs)
+
+    def submit(self, prompt, max_new_tokens, *, priority=0,
+               eos_token_id=None, seed=0) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        assert prompt.size >= 1 and max_new_tokens >= 1
+        total = prompt.size + int(max_new_tokens)
+        assert total <= self.capacity_per_seq, \
+            f"prompt+max_new={total} exceeds per-sequence capacity " \
+            f"{self.capacity_per_seq} (W={self.W} blocks x {self.bs}, " \
+            f"{self.pool.blocks_per_shard - 1} usable blocks/shard)"
+        rid = next(self._rids)
+        req = Request(rid=rid, prompt=prompt,
+                      max_new_tokens=int(max_new_tokens),
+                      priority=int(priority), eos_token_id=eos_token_id,
+                      seed=int(seed))
+        self.scheduler.submit(req)
+        self.metrics.record_submit(rid)
+        return rid
+
+    def cancel(self, rid) -> bool:
+        req = self.scheduler.cancel(rid)
+        if req is None:
+            return False
+        self._cleanup(req, "cancelled")
+        return True
+
+    def step(self) -> dict:
+        """One serving tick: chaos hooks, at most one prefill chunk, one
+        batched decode dispatch, then host-side bookkeeping on a SINGLE
+        batched token fetch."""
+        self._step_idx += 1
+        if self._watchdog is not None:
+            self._watchdog.heartbeat()
+        events = {"admitted": [], "finished": [], "evicted": [],
+                  "cancelled": []}
+        rid = self.scheduler.chaos_cancel()
+        if rid is not None and self.cancel(rid):
+            events["cancelled"].append(rid)
+        self._prefill_tick(events)
+        decoded = self._decode_tick(events)
+        self.scheduler.on_drained()
+        occ = self.pool.occupancy()
+        frag = self.pool.fragmentation()
+        qd = self.scheduler.queue_depth()
+        self.metrics.record_step(
+            queue_depth=qd, running=decoded, slots=self.max_slots,
+            occupancy=occ, fragmentation=frag, decoded=decoded > 0)
+        self._last_metrics = {
+            "step": self._step_idx, "queue_depth": qd,
+            "running": len(self.scheduler.running),
+            "kv_occupancy": occ, "kv_fragmentation": frag,
+            "decoded_lanes": decoded,
+            "events": {k: len(v) for k, v in events.items()},
+        }
+        return events
+
+    def serve(self, *, max_steps=100000) -> dict:
+        steps = 0
+        while self.scheduler.has_work():
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"serve() exceeded max_steps={max_steps} with "
+                    f"{self.scheduler.queue_depth()} queued")
+            self.step()
+            steps += 1
+        return self.results
+
+    def warmup(self) -> None:
+        """Compile every program the steady state can need — the decode
+        jit plus each (bucket, final/non-final) prefill variant that an
+        ADMISSIBLE request can reach — by serving throwaway requests,
+        then reset results/metrics.  After warmup, request churn
+        triggers ZERO new compilations.
+
+        Coverage argument: a final chunk of residue r compiles the same
+        program as any residue in its power-of-two bucket, and every
+        reachable bucket admits a single-chunk prompt of length r
+        (multi-chunk prompts only shrink the admissible residue), so one
+        short prompt per bucket plus ONE prompt longer than
+        prefill_chunk (iff any admissible prompt is) covers everything."""
+        assert not self.scheduler.has_work(), "warmup on a busy engine"
+        cap = self.capacity_per_seq
+        lens = set()
+        for b in self._buckets():
+            n = b if b == _MIN_BUCKET else b // 2 + 1
+            if n + 1 <= cap:
+                lens.add(n)               # single-chunk final, bucket b
+        if cap - 1 > self.prefill_chunk:
+            # some admissible prompt spans chunks: compile the non-final
+            # (always full-chunk) variant too
+            lens.add(min(2 * self.prefill_chunk, cap - 1))
+        for ln in sorted(lens):
+            self.submit(np.zeros(ln, np.int32),
+                        max_new_tokens=min(2, cap - ln))
+        if cap >= 3:
+            # the first token comes from the prefill-final jit; the
+            # decode jit only compiles on a SECOND token — guarantee one
+            # even when every bucket prompt above could only afford
+            # max_new=1
+            self.submit(np.zeros(1, np.int32), max_new_tokens=2)
+        self.serve()
+        self.results.clear()
+        self.metrics.reset()
+        self._last_metrics = {}
+        self._step_idx = 0
+
+    def result(self, rid) -> np.ndarray:
+        """prompt + generated tokens of a finished/cancelled request."""
+        return self.results[rid]["tokens"]
+
+    def serving_report(self) -> dict:
+        """TTFT / TPOT / throughput / queue-depth / KV-pool occupancy of
+        the run so far — the serving analog of the training engine's
+        comm_volume_report(): pure host accounting, no device sync."""
+        rep = self.metrics.report()
+        rep["config"] = {
+            "max_slots": self.max_slots, "shards": self.shards,
+            "kv_block_size": self.bs, "kv_blocks": self.pool.num_blocks,
+            "max_blocks_per_seq": self.W,
+            "prefill_chunk": self.prefill_chunk,
+            "quantized_kv": self.pool.quantized,
+            "policy": self.scheduler.policy,
+            "temperature": self.temperature, "top_k": self.top_k,
+            "top_p": self.top_p,
+        }
+        rep["kv_pool"]["now"] = self.pool.stats()
+        return rep
+
+    def decode_hlo(self) -> str:
+        """Compiled HLO of the decode program (for the graftlint HLO
+        contracts: host-transfer-free, pool donated, zero collectives)."""
+        args = (self.params, *self.pool.tensors.arrays, self._tables,
+                self._pos, self._tok, self._active, self._seeds)
+        return self._decode.lower(*args).compile().as_text()
+
+    def n_pool_tensors(self) -> int:
+        return len(self.pool.tensors.arrays)
+
+    # -- internals ------------------------------------------------------
+    def _buckets(self):
+        b, out = _MIN_BUCKET, []
+        while b <= self.prefill_chunk:
+            out.append(b)
+            b *= 2
+        return out
+
+    def _bucket(self, n):
+        for b in self._buckets():
+            if n <= b:
+                return b
+        raise AssertionError(f"chunk {n} > prefill_chunk")
+
+    def _rebind(self, arrays):
+        # 2 arrays (k, v) or 4 (+ scales); the NamedTuple defaults cover
+        # the missing scale slots with None
+        self.pool.tensors = PoolTensors(*arrays)
+
+    def _shard_for_slot(self, slot):
+        return slot // (self.max_slots // self.shards)
+
+    def _ensure_blocks(self, req, n_positions, *, admission, events):
+        """Grow ``req``'s page table to cover ``n_positions``, preempting
+        victims from the scheduler's policy until the shard has room.
+        False = req itself was deferred/evicted (caller must not use
+        it this step)."""
+        while not self.pool.alloc(req.rid, req.shard, n_positions):
+            victim = self.scheduler.victim(for_req=req,
+                                           admission=admission,
+                                           shard=req.shard)
+            if victim is None:
+                if admission:
+                    self.scheduler.drop_prefill(req, requeue=True)
+                    self.pool.free(req.rid)
+                else:
+                    self._evict(req, events)
+                return False
+            self._evict(victim, events)
+        return True
+
+    def _evict(self, req, events):
+        slot = req.slot
+        self.scheduler.preempt(req)
+        self.pool.free(req.rid)
+        self._clear_slot(slot)
+        self.metrics.record_eviction(req.rid)
+        events["evicted"].append(req.rid)
+
+    def _clear_slot(self, slot):
+        if slot is None:
+            return
+        self._active[slot] = False
+        self._tables[slot] = TRASH_BLOCK
+        self._pos[slot] = 0
+        self._tok[slot] = 0
+
+    def _cleanup(self, req, reason):
+        self.pool.free(req.rid)
+        self._clear_slot(req.slot)
+        self.results[req.rid] = {
+            "tokens": np.concatenate(
+                [req.prompt, np.asarray(req.generated, np.int32)]),
+            "status": reason, "evictions": req.evictions,
+        }
+        self.metrics.record_finish(req.rid, reason)
+
+    def _finish(self, req, reason, events):
+        self.scheduler.finish(req, reason)
+        self._cleanup(req, reason)
+        events["finished"].append(req.rid)
+
+    def _on_new_token(self, req, token, events, *, promote):
+        req.generated.append(int(token))
+        self.metrics.record_token(req.rid)
+        if req.done:
+            self._finish(req, "finished", events)
+            return
+        if promote:
+            self.scheduler.promote(req)
+            slot = req.slot
+            self._tables[slot] = self.pool.table_row(req.rid, self.W)
+            self._pos[slot] = len(req.full_tokens) - 1
+            self._tok[slot] = req.generated[-1]
+            self._seeds[slot] = req.seed
+            self._active[slot] = True
+
+    def _prefill_args(self, req, n):
+        rows = np.full((self.shards, self.W), TRASH_BLOCK, np.int32)
+        nv = np.zeros(self.shards, np.int32)
+        rows[req.shard] = self.pool.table_row(req.rid, self.W)
+        nv[req.shard] = n
+        return rows, nv
+
+    def _prefill_tick(self, events):
+        sch = self.scheduler
+        req = sch.prefilling
+        if req is None:
+            req = sch.start_admission()
+            if req is None:
+                return
+            req.shard = self._shard_for_slot(req.slot)
+            events["admitted"].append(req.rid)
+        toks = req.full_tokens
+        total = len(toks)
+        start = req.prefill_done
+        n = min(self.prefill_chunk, total - start)
+        final = start + n == total
+        # the final chunk also reserves the first decode write position
+        if not self._ensure_blocks(req, start + n + (1 if final else 0),
+                                   admission=True, events=events):
+            return
+        bucket = self._bucket(n)
+        tok_pad = np.zeros(bucket, np.int32)
+        tok_pad[:n] = toks[start:start + n]
+        fn = _make_prefill_chunk(
+            self.cfg, bucket, self.W, self.bs, self.pool.quantized, final,
+            self.temperature, self.top_k, self.top_p, self.mesh,
+            self.axis_name)
+        rows, nv = self._prefill_args(req, n)
+        out = fn(self.params, *self.pool.tensors.arrays, rows, tok_pad,
+                 np.int32(start), nv, np.int32(req.seed))
+        if final:
+            nxt = out[-1]
+            self._rebind(out[:-1])
+            first = int(np.asarray(
+                jax.device_get(nxt)).reshape(-1)[req.shard])
+            req.prefill_done = total
+            self._on_new_token(req, first, events, promote=True)
+        else:
+            self._rebind(out)
+            req.prefill_done = start + n
+
+    def _decode_tick(self, events):
+        sch = self.scheduler
+        if not sch.running:
+            return 0
+        # growth: each lane writes position pos this step — make sure its
+        # page table covers it, preempting within the lane's shard if the
+        # pool is full
+        for slot in sorted(sch.running):
+            req = sch.running.get(slot)
+            if req is None:
+                continue
+            self._ensure_blocks(req, int(self._pos[slot]) + 1,
+                                admission=False, events=events)
+        running = dict(sch.running)
+        if not running:
+            return 0
+        for slot, req in running.items():
+            self._tables[slot] = self.pool.table_row(req.rid, self.W)
+        out = self._decode(self.params, *self.pool.tensors.arrays,
+                           self._tables, self._pos, self._tok,
+                           self._active, self._seeds)
+        nxt = out[-1]
+        self._rebind(out[:-1])
+        toks = np.asarray(jax.device_get(nxt))
+        for slot, req in running.items():
+            self._pos[slot] += 1
+            self._tok[slot] = int(toks[slot])
+            self._on_new_token(req, int(toks[slot]), events,
+                               promote=False)
+        return len(running)

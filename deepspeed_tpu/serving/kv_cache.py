@@ -1,0 +1,227 @@
+"""Paged KV cache: fixed block pool + per-sequence page tables.
+
+The single-sequence decode loop in models/generation.py preallocates one
+contiguous (L, B, H, S_max, D) cache per call — fine for a batch that
+lives and dies together, fatal for serving where sequences of wildly
+different lengths join and leave every step.  This module is the
+vLLM-style answer (PagedAttention, arXiv 2309.06180): KV lives in a
+fixed pool of ``block_size``-token blocks, each sequence holds an
+ordered page table of block ids, and the pool arrays are DONATED into
+the decode jit and updated in place — steady-state decode allocates no
+device memory at all.
+
+Layout: ``k``/``v`` are ``(L, num_blocks, H, block_size, D)``; the
+gathered per-sequence view reassembles ``(H, W*block_size, D)`` in
+absolute-position order, so the attention math (shared
+``generation._attn_core``) is bit-identical to the contiguous cache.
+
+Block 0 of every shard is a reserved TRASH block: masked lanes (inactive
+slots, prefill padding) route their writes there, which keeps every
+scatter in the jit fully dense — no branches, no recompiles.
+
+Optional int8 storage (``quantize_kv=True``) stores one symmetric scale
+per (token, head) row via runtime/quantization.py's row quantizers —
+per-row layout = ``block_layout(D, D)`` so the scale tensor is exactly
+``(L, num_blocks, H, block_size)`` f32.  Arming follows the repo's
+DISARMED discipline: when the configuration cannot profit (scale
+overhead >= byte savings, or an unsupported pool dtype) the pool warns
+loudly naming the blocker and serves full-precision instead.
+
+Sharding (``shards > 1``): the block axis and the allocator are split
+into per-shard ranges so a shard_map over the slot axis sees only local
+blocks — the placement-semantics argument for why sharded decode moves
+zero collective bytes (see runtime/comm_accounting.
+serving_decode_collectives).
+"""
+from typing import Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+TRASH_BLOCK = 0          # per-shard block 0 absorbs masked writes
+
+
+class PoolTensors(NamedTuple):
+    """The device-side pool state threaded through (and donated into)
+    the decode/prefill jits.  ``k_scale``/``v_scale`` are None unless
+    int8 KV is armed."""
+    k: jax.Array
+    v: jax.Array
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
+
+    @property
+    def arrays(self):
+        return tuple(t for t in self if t is not None)
+
+
+class PagedKVPool:
+    """Fixed device block pool + host-side block allocator/page tables.
+
+    ``num_blocks`` is the TOTAL block count across shards (must divide by
+    ``shards``); one block per shard is reserved as trash, so the usable
+    capacity is ``num_blocks - shards`` blocks.
+    """
+
+    def __init__(self, cfg, *, num_blocks, block_size=16, shards=1,
+                 mesh=None, axis_name="data", quantize_kv=False,
+                 dtype=None):
+        assert num_blocks % shards == 0, \
+            f"num_blocks={num_blocks} must divide shards={shards}"
+        assert num_blocks // shards >= 2, \
+            "need at least one usable block per shard beyond the trash block"
+        assert block_size >= 1
+        self.cfg = cfg
+        self.block_size = int(block_size)
+        self.shards = int(shards)
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.num_blocks = int(num_blocks)
+        self.blocks_per_shard = self.num_blocks // self.shards
+        self.dtype = dtype or cfg.dtype
+        self.quantized = self._arm_quantized_kv(quantize_kv)
+
+        L, H, D = cfg.n_layer, cfg.n_head, cfg.head_dim
+        bs = self.block_size
+        kv_shape = (L, self.num_blocks, H, bs, D)
+        store = jnp.int8 if self.quantized else self.dtype
+        k = jnp.zeros(kv_shape, store)
+        v = jnp.zeros(kv_shape, store)
+        sk = sv = None
+        if self.quantized:
+            sk = jnp.zeros((L, self.num_blocks, H, bs), jnp.float32)
+            sv = jnp.zeros((L, self.num_blocks, H, bs), jnp.float32)
+        if mesh is not None and shards > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            put = lambda t, spec: jax.device_put(
+                t, NamedSharding(mesh, spec))
+            k = put(k, P(None, axis_name))
+            v = put(v, P(None, axis_name))
+            if self.quantized:
+                sk = put(sk, P(None, axis_name))
+                sv = put(sv, P(None, axis_name))
+        self.tensors = PoolTensors(k, v, sk, sv)
+
+        # host-side allocator: per-shard sorted free lists (popping the
+        # smallest id keeps runs deterministic), local block ids — the
+        # trash block (0) is never handed out
+        self._free: List[List[int]] = [
+            list(range(1, self.blocks_per_shard))
+            for _ in range(self.shards)]
+        self._blocks: Dict[int, List[int]] = {}    # rid -> local block ids
+        self._shard_of: Dict[int, int] = {}
+        self._positions: Dict[int, int] = {}       # rid -> covered positions
+
+    # -- arming ---------------------------------------------------------
+    def _arm_quantized_kv(self, requested):
+        """int8 KV arms only where it actually saves bytes; every blocked
+        request warns loudly (the armed-or-warns DISARMED discipline)."""
+        if not requested:
+            return False
+        elem = np.dtype(self.dtype).itemsize
+        D = self.cfg.head_dim
+        if np.dtype(self.dtype) == np.float64:
+            logger.warning(
+                "PagedKVPool: int8 KV quantization DISARMED — pool dtype "
+                "float64 is not supported by the symmetric per-row scheme "
+                "(scales are f32); serving full-precision KV instead.")
+            return False
+        if D * (elem - 1) <= 4:
+            logger.warning(
+                "PagedKVPool: int8 KV quantization DISARMED — head_dim=%d "
+                "at %s saves %d bytes/row but the per-(token,head) f32 "
+                "scale costs 4; int8 would GROW the pool. Serving "
+                "full-precision KV instead.",
+                D, np.dtype(self.dtype).name, D * (elem - 1))
+            return False
+        return True
+
+    # -- allocator ------------------------------------------------------
+    def blocks_needed(self, n_positions: int) -> int:
+        return -(-int(n_positions) // self.block_size)
+
+    def alloc(self, rid: int, shard: int, n_positions: int) -> bool:
+        """Ensure ``rid`` (pinned to ``shard``) owns enough blocks to
+        cover ``n_positions`` absolute positions.  Returns False — with
+        NOTHING changed — when the shard's free list cannot cover the
+        growth; the caller preempts a victim and retries."""
+        assert 0 <= shard < self.shards
+        have = self._blocks.setdefault(rid, [])
+        prev = self._shard_of.setdefault(rid, shard)
+        assert prev == shard, f"rid {rid} moved shards {prev}->{shard}"
+        need = self.blocks_needed(n_positions) - len(have)
+        if need > len(self._free[shard]):
+            if not have:
+                self._drop(rid)
+            return False
+        for _ in range(max(0, need)):
+            have.append(self._free[shard].pop(0))
+        self._positions[rid] = max(self._positions.get(rid, 0),
+                                   int(n_positions))
+        return True
+
+    def free(self, rid: int) -> None:
+        """Return every block of ``rid`` to its shard's free list."""
+        blocks = self._blocks.pop(rid, [])
+        shard = self._shard_of.pop(rid, 0)
+        self._positions.pop(rid, None)
+        self._free[shard] = sorted(self._free[shard] + blocks)
+
+    def _drop(self, rid):
+        self._blocks.pop(rid, None)
+        self._shard_of.pop(rid, None)
+        self._positions.pop(rid, None)
+
+    def table_row(self, rid: int, width: int) -> np.ndarray:
+        """LOCAL block ids of ``rid`` padded with the trash block to the
+        fixed table width (the decode jit's static W)."""
+        blocks = self._blocks.get(rid, [])
+        assert len(blocks) <= width, \
+            f"rid {rid} holds {len(blocks)} blocks > table width {width}"
+        row = np.full(width, TRASH_BLOCK, np.int32)
+        row[:len(blocks)] = blocks
+        return row
+
+    def free_blocks(self, shard: int) -> int:
+        """Free blocks on one shard — the admission slot-ranking signal
+        (the engine steers new sequences toward the least-loaded shard)."""
+        return len(self._free[shard])
+
+    # -- accounting -----------------------------------------------------
+    @property
+    def usable_blocks(self) -> int:
+        return self.num_blocks - self.shards          # minus trash blocks
+
+    @property
+    def blocks_in_use(self) -> int:
+        return sum(len(b) for b in self._blocks.values())
+
+    def occupancy(self) -> float:
+        return self.blocks_in_use / max(1, self.usable_blocks)
+
+    def fragmentation(self) -> float:
+        """Internal fragmentation: fraction of ALLOCATED pool positions
+        not covered by live tokens (tail slack of each sequence's last
+        block).  0 = every allocated slot holds a token."""
+        allocated = self.blocks_in_use * self.block_size
+        if allocated == 0:
+            return 0.0
+        used = sum(self._positions.values())
+        return 1.0 - used / allocated
+
+    def stats(self) -> dict:
+        return {
+            "blocks_total": self.usable_blocks,
+            "blocks_in_use": self.blocks_in_use,
+            "occupancy": self.occupancy(),
+            "fragmentation": self.fragmentation(),
+            "block_size": self.block_size,
+            "shards": self.shards,
+            "quantized": self.quantized,
+            "free_per_shard": [len(f) for f in self._free],
+        }

@@ -1,0 +1,179 @@
+"""Serving metrics: TTFT, TPOT, throughput, queue depth, pool occupancy.
+
+Follows the engine's ``_last_metrics`` / ``comm_volume_report()`` idiom:
+the engine feeds observations as plain host floats (never a device sync
+— the decode token fetch already happened, batched, once per step) and
+``report()`` assembles the summary dict that
+``InferenceEngine.serving_report()`` returns.
+
+Also home of :class:`CompilationCounter`, the compilation-count hook the
+recompile-guard acceptance test uses: jax fires one
+``/jax/core/compile/backend_compile_duration`` monitoring event per XLA
+backend compilation, so steady-state serving (requests joining/leaving a
+warmed engine) must count ZERO inside the guard window.
+"""
+import time
+from typing import Dict, List
+
+_MONITORING_KEY = "backend_compile"
+_counters: List["CompilationCounter"] = []
+_listener_installed = False
+
+
+def _on_event(name, *args, **kwargs):
+    if _MONITORING_KEY in name:
+        for c in _counters:
+            c.count += 1
+
+
+def _install_listener():
+    # jax.monitoring has no unregister; install ONE module-level listener
+    # forever and let counters arm/disarm themselves on the host side
+    global _listener_installed
+    if _listener_installed:
+        return
+    from jax import monitoring
+
+    monitoring.register_event_duration_secs_listener(_on_event)
+    _listener_installed = True
+
+
+class CompilationCounter:
+    """Counts XLA backend compilations while active (context manager)."""
+
+    def __init__(self):
+        self.count = 0
+
+    def __enter__(self):
+        _install_listener()
+        self.count = 0
+        _counters.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _counters.remove(self)
+        return False
+
+
+def _mean(xs):
+    return sum(xs) / len(xs) if xs else None
+
+
+def _pct(xs, q):
+    if not xs:
+        return None
+    s = sorted(xs)
+    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
+
+
+class ServingMetrics:
+    """Per-request latency + per-step utilization accounting."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self.reset()
+
+    def reset(self):
+        self._arrival: Dict[int, float] = {}
+        self._first_token: Dict[int, float] = {}
+        self._last_token: Dict[int, float] = {}
+        self._tokens: Dict[int, int] = {}
+        self.ttft: List[float] = []
+        self.completed = 0
+        self.cancelled = 0
+        self.evictions = 0
+        self.steps = 0
+        self.decode_steps = 0
+        self.slot_steps = 0            # decode lanes dispatched (incl. idle)
+        self.active_slot_steps = 0     # decode lanes carrying a request
+        self.total_tokens = 0          # generated tokens, all requests
+        self.useful_tokens = 0         # tokens of requests that FINISHED
+        self._queue_depth: List[int] = []
+        self._occupancy: List[float] = []
+        self._fragmentation: List[float] = []
+        self._t0 = None
+        self._t_end = None
+
+    # -- request lifecycle ---------------------------------------------
+    def record_submit(self, rid):
+        self._arrival[rid] = self._clock()
+
+    def record_token(self, rid):
+        now = self._clock()
+        if rid not in self._first_token:
+            self._first_token[rid] = now
+            if rid in self._arrival:
+                self.ttft.append(now - self._arrival[rid])
+        self._last_token[rid] = now
+        self._tokens[rid] = self._tokens.get(rid, 0) + 1
+        self.total_tokens += 1
+
+    def record_finish(self, rid, reason="finished"):
+        if reason == "cancelled":
+            self.cancelled += 1
+        else:
+            self.completed += 1
+            self.useful_tokens += self._tokens.get(rid, 0)
+
+    def record_eviction(self, rid):
+        self.evictions += 1
+
+    # -- per step -------------------------------------------------------
+    def record_step(self, *, queue_depth, running, slots, occupancy,
+                    fragmentation, decoded):
+        now = self._clock()
+        if self._t0 is None:
+            self._t0 = now
+        self._t_end = now
+        self.steps += 1
+        if decoded:
+            self.decode_steps += 1
+            self.slot_steps += slots
+            self.active_slot_steps += running
+        self._queue_depth.append(queue_depth)
+        self._occupancy.append(occupancy)
+        self._fragmentation.append(fragmentation)
+
+    # -- summary --------------------------------------------------------
+    def tpot(self):
+        """Mean time-per-output-token over requests with >= 2 tokens."""
+        spans, counts = 0.0, 0
+        for rid, n in self._tokens.items():
+            if n >= 2 and rid in self._first_token:
+                spans += self._last_token[rid] - self._first_token[rid]
+                counts += n - 1
+        return spans / counts if counts else None
+
+    def report(self) -> dict:
+        wall = (self._t_end - self._t0) if self._t0 is not None else 0.0
+        return {
+            "requests": {
+                "completed": self.completed,
+                "cancelled": self.cancelled,
+                "evictions": self.evictions,
+            },
+            "ttft_s": {"mean": _mean(self.ttft), "p50": _pct(self.ttft, .5),
+                       "p95": _pct(self.ttft, .95),
+                       "max": max(self.ttft) if self.ttft else None},
+            "tpot_s": self.tpot(),
+            "tokens": {"generated": self.total_tokens,
+                       "useful": self.useful_tokens},
+            "throughput": {
+                "wall_s": wall,
+                "tokens_per_s": (self.total_tokens / wall) if wall > 0
+                else None,
+                # hardware-time proxy, deterministic on CPU: how full the
+                # fixed decode batch ran (1.0 = every lane of every decode
+                # dispatch carried a live request)
+                "tokens_per_slot_step": (self.total_tokens / self.slot_steps)
+                if self.slot_steps else None,
+                "slot_utilization": (self.active_slot_steps / self.slot_steps)
+                if self.slot_steps else None,
+            },
+            "steps": {"total": self.steps, "decode": self.decode_steps},
+            "queue_depth": {"mean": _mean(self._queue_depth),
+                            "max": max(self._queue_depth, default=0)},
+            "kv_pool": {"occupancy_mean": _mean(self._occupancy),
+                        "occupancy_max": max(self._occupancy, default=0.0),
+                        "fragmentation_mean": _mean(self._fragmentation)},
+        }

@@ -1,0 +1,285 @@
+"""Token-level continuous batching: admit / evict between decode steps.
+
+The scheduler is pure host-side policy — no device state.  It owns the
+waiting queue (priority classes, FCFS within a class), the running-slot
+map, the single in-flight chunked prefill, and the victim choice for
+eviction.  The engine consults it between decode steps; every decision
+is deterministic (heap keyed on (priority, submit_seq)) so parity tests
+can replay exact schedules.
+
+Policies:
+
+- ``continuous`` (the point of this subsystem): a slot freed by a
+  finished/evicted/cancelled request is refilled on the very next step;
+- ``static`` (the naive baseline tools/serve_bench.py measures against):
+  admission only happens while the batch gate is open — the gate opens
+  when the engine fully drains and closes once the batch is formed, so
+  every batch runs to its slowest member like a classic batched
+  ``generate()`` call.
+
+Eviction: when the KV pool cannot cover a growth or an admission, the
+victim is the least-important (highest priority value), youngest running
+request — preempted requests keep their generated tokens and re-enter
+the waiting queue for a chunked re-prefill of prompt+generated (the
+recompute flavor of preemption; parity tests pin that the continuation
+is bit-identical).  Admission only ever preempts STRICTLY less important
+requests; growth of a running sequence may preempt its own class but
+never a more important one, and self-evicts when nothing else yields.
+
+Chaos tie-in: ``chaos_cancel`` consults
+runtime/resilience/chaos.serving_cancel_request so fault-injection tests
+can drive request-cancellation churn through the same code path users
+hit.
+"""
+import heapq
+import itertools
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.runtime.resilience import chaos
+
+
+class RequestState(Enum):
+    WAITING = "waiting"
+    PREFILL = "prefill"
+    RUNNING = "running"
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+
+
+@dataclass
+class Request:
+    """One generation request.  ``generated`` survives eviction: on
+    re-admission the prefill covers prompt+generated and decoding
+    continues where it stopped."""
+    rid: int
+    prompt: np.ndarray                 # (S0,) int32
+    max_new_tokens: int
+    priority: int = 0                  # lower = more important
+    eos_token_id: Optional[int] = None
+    seed: int = 0
+    # -- dynamic state --------------------------------------------------
+    state: RequestState = RequestState.WAITING
+    generated: List[int] = field(default_factory=list)
+    prefill_done: int = 0              # pool positions already written
+    slot: Optional[int] = None
+    shard: int = 0
+    submit_seq: int = -1
+    evictions: int = 0
+    finish_reason: Optional[str] = None
+
+    @property
+    def full_tokens(self) -> np.ndarray:
+        """Every KNOWN token — what a (re-)prefill must cover."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int32)]) \
+            if self.generated else self.prompt
+
+    @property
+    def remaining_new_tokens(self) -> int:
+        return self.max_new_tokens - len(self.generated)
+
+    @property
+    def done(self) -> bool:
+        if self.remaining_new_tokens <= 0:
+            return True
+        return (self.eos_token_id is not None and self.generated
+                and self.generated[-1] == self.eos_token_id)
+
+    def sort_key(self):
+        return (self.priority, self.submit_seq)
+
+
+class Scheduler:
+    def __init__(self, max_slots: int, *, policy: str = "continuous"):
+        assert policy in ("continuous", "static"), policy
+        self.max_slots = int(max_slots)
+        self.policy = policy
+        self._seq = itertools.count()
+        self._waiting: List = []                  # heap of (key, rid)
+        self.requests: Dict[int, Request] = {}    # every live request
+        self.running: Dict[int, Request] = {}     # slot -> Request
+        self.prefilling: Optional[Request] = None
+        # static-policy batch gate: a batch's MEMBERSHIP is fixed when it
+        # forms — the budget stops freed lanes from being refilled until
+        # the whole batch drains (that refill IS continuous batching)
+        self._gate_open = True
+        self._batch_left = self.max_slots
+        self.chaos_step = 0
+
+    # -- queue ----------------------------------------------------------
+    def submit(self, req: Request) -> None:
+        req.submit_seq = next(self._seq)
+        req.state = RequestState.WAITING
+        self.requests[req.rid] = req
+        heapq.heappush(self._waiting, (req.sort_key(), req.rid))
+
+    def _requeue(self, req: Request) -> None:
+        # preempted requests keep their ORIGINAL submit_seq: FCFS age, not
+        # eviction time, decides their place back in line
+        req.state = RequestState.WAITING
+        req.prefill_done = 0
+        req.slot = None
+        heapq.heappush(self._waiting, (req.sort_key(), req.rid))
+
+    def _pop_waiting(self) -> Optional[Request]:
+        while self._waiting:
+            _, rid = heapq.heappop(self._waiting)
+            req = self.requests.get(rid)
+            if req is not None and req.state is RequestState.WAITING:
+                return req
+        return None
+
+    def peek_waiting(self) -> Optional[Request]:
+        while self._waiting:
+            _, rid = self._waiting[0]
+            req = self.requests.get(rid)
+            if req is not None and req.state is RequestState.WAITING:
+                return req
+            heapq.heappop(self._waiting)
+        return None
+
+    def queue_depth(self) -> int:
+        return sum(1 for r in self.requests.values()
+                   if r.state is RequestState.WAITING)
+
+    def has_work(self) -> bool:
+        return bool(self.running) or self.prefilling is not None \
+            or self.queue_depth() > 0
+
+    # -- slots ----------------------------------------------------------
+    # the engine installs a ranker so admission steers toward the slot
+    # whose pool shard has the most free blocks (ties -> lowest slot);
+    # without one, first-free wins
+    slot_ranker = None
+
+    def free_slot(self) -> Optional[int]:
+        taken = set(self.running)
+        if self.prefilling is not None and self.prefilling.slot is not None:
+            taken.add(self.prefilling.slot)
+        free = [s for s in range(self.max_slots) if s not in taken]
+        if not free:
+            return None
+        if self.slot_ranker is None:
+            return free[0]
+        return max(free, key=lambda s: (self.slot_ranker(s), -s))
+
+    def may_admit(self) -> bool:
+        if self.policy == "continuous":
+            return True
+        return self._gate_open
+
+    def on_drained(self) -> None:
+        """Engine signal: no running, no prefilling — a static batch may
+        form again."""
+        if not self.running and self.prefilling is None:
+            self._gate_open = True
+            self._batch_left = self.max_slots
+
+    def start_admission(self) -> Optional[Request]:
+        """Pop the next admissible request into the PREFILL state (the
+        engine assigns shard + drives chunks).  None when no slot, no
+        candidate, or the static gate is closed."""
+        if self.prefilling is not None or not self.may_admit():
+            return None
+        slot = self.free_slot()
+        if slot is None:
+            return None
+        req = self._pop_waiting()
+        if req is None:
+            if self.policy == "static" and (self.running or self.prefilling):
+                self._gate_open = False   # batch formed: queue exhausted
+            return None
+        if self.policy == "static":
+            self._batch_left -= 1
+            if self._batch_left <= 0:
+                self._gate_open = False   # batch formed: slots budgeted
+        req.state = RequestState.PREFILL
+        req.slot = slot
+        self.prefilling = req
+        return req
+
+    def promote(self, req: Request) -> None:
+        """Prefill finished: the request joins the decode batch."""
+        assert req is self.prefilling
+        self.prefilling = None
+        req.state = RequestState.RUNNING
+        self.running[req.slot] = req
+
+    def drop_prefill(self, req: Request, *, requeue: bool) -> None:
+        assert req is self.prefilling
+        self.prefilling = None
+        if self.policy == "static":
+            # the dropped request was the LAST admission: hand its batch
+            # budget back (and reopen the gate it may just have closed),
+            # or repeated drop/re-admit cycles shrink the batch
+            self._batch_left += 1
+            self._gate_open = True
+        if requeue:
+            self._requeue(req)
+
+    # -- eviction / completion ------------------------------------------
+    def victim(self, *, for_req: Request, admission: bool,
+               shard: Optional[int] = None) -> Optional[Request]:
+        """Who to preempt so ``for_req`` can take blocks.  Admission only
+        preempts STRICTLY less important runners; growth may preempt its
+        own class (youngest first) but never itself.  ``shard`` filters
+        to victims whose blocks actually help (same pool shard)."""
+        candidates = [r for r in self.running.values() if r is not for_req]
+        if shard is not None:
+            candidates = [r for r in candidates if r.shard == shard]
+        if admission:
+            candidates = [r for r in candidates
+                          if r.priority > for_req.priority]
+        else:
+            candidates = [r for r in candidates
+                          if r.priority >= for_req.priority]
+        if not candidates:
+            return None
+        # least important first, then youngest (largest submit_seq)
+        return max(candidates,
+                   key=lambda r: (r.priority, r.submit_seq))
+
+    def preempt(self, req: Request) -> None:
+        """Remove a RUNNING request and requeue it (tokens preserved)."""
+        assert req.slot in self.running and self.running[req.slot] is req
+        del self.running[req.slot]
+        req.evictions += 1
+        self._requeue(req)
+
+    def finish(self, req: Request, reason: str = "finished") -> None:
+        if req.slot is not None and self.running.get(req.slot) is req:
+            del self.running[req.slot]
+        if req is self.prefilling:
+            self.prefilling = None
+        req.state = RequestState.CANCELLED if reason == "cancelled" \
+            else RequestState.FINISHED
+        req.finish_reason = reason
+        # req.slot is deliberately NOT cleared: the engine still needs it
+        # to scrub the slot's host arrays (active mask, page-table row)
+        self.requests.pop(req.rid, None)
+
+    def cancel(self, rid: int) -> Optional[Request]:
+        """Cancel a request in ANY live state; returns it (the engine
+        frees its pool blocks) or None if unknown/already finished."""
+        req = self.requests.get(rid)
+        if req is None:
+            return None
+        self.finish(req, reason="cancelled")
+        return req
+
+    def chaos_cancel(self) -> Optional[int]:
+        """Chaos-driven cancellation: when an armed ChaosPlan fires at
+        this scheduler step, cancel the YOUNGEST running request
+        (deterministic victim) through the normal cancel path."""
+        self.chaos_step += 1
+        if not chaos.serving_cancel_request(self.chaos_step):
+            return None
+        if not self.running:
+            return None
+        victim = max(self.running.values(), key=lambda r: r.submit_seq)
+        chaos.record_serving_cancel(victim.rid)
+        return victim.rid

@@ -396,11 +396,41 @@ def test_host_sync_fires_in_make_factory_defs():
 
 @pytest.mark.parametrize("path", ["deepspeed_tpu/runtime/engine.py",
                                   "deepspeed_tpu/runtime/pipe/engine.py",
-                                  "bench.py", "tools/pipe_bench.py"])
+                                  "bench.py", "tools/pipe_bench.py",
+                                  "tools/serve_bench.py"])
 def test_host_sync_fires_in_hot_loop(path):
     got = lint(HS_HOT_LOOP_BAD, path, rules=["host-sync"])
     assert rule_names(got) == ["host-sync"], path
     assert "per-iteration loop" in got[0].message
+
+
+HS_SERVING_BAD = """
+class InferenceEngine:
+    def step(self):
+        for slot, req in self.scheduler.running.items():
+            tok = int(jax.device_get(self._nxt[slot]))
+            req.generated.append(tok)
+"""
+
+HS_SERVING_GOOD = """
+class InferenceEngine:
+    def step(self):
+        out = self._decode(self.params, self._tables)
+        toks = np.asarray(jax.device_get(out))
+        for slot, req in self.scheduler.running.items():
+            req.generated.append(int(toks[slot]))
+"""
+
+
+@pytest.mark.parametrize("path", ["deepspeed_tpu/serving/engine.py",
+                                  "deepspeed_tpu/serving/scheduler.py"])
+def test_host_sync_serving_per_token_fetch_is_an_error(path):
+    """PR-5 satellite: the serving hot paths are held to the training
+    engines' bar — a per-slot/per-token device_get in the step loop
+    fires; ONE batched fetch after dispatch is the blessed idiom."""
+    got = lint(HS_SERVING_BAD, path, rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"], path
+    assert lint(HS_SERVING_GOOD, path, rules=["host-sync"]) == []
 
 
 def test_host_sync_quiet_on_batched_fetch_after_loop():

@@ -126,6 +126,26 @@ def test_entry_output_dtypes_parses_signature():
     assert hc.entry_output_dtypes(hlo) == ["bf16"]
 
 
+def test_donation_contract_fires_and_quiets():
+    """assert_donates: fires when a 'state-updating' jit does NOT alias
+    its input to the output (every call pays a copy), quiets when the
+    argument is donated."""
+    def update(state, x):
+        return state.at[0].add(x), state.sum()
+
+    state = jnp.zeros((16, 16), jnp.float32)
+    x = jnp.ones(16, jnp.float32)
+    bad = jax.jit(update).lower(state, x).compile().as_text()
+    assert hc.donated_params(bad) == set()
+    with pytest.raises(hc.HloContractError, match="must donate"):
+        hc.assert_donates(bad, [0], "undonated fixture")
+
+    good = jax.jit(update, donate_argnums=(0,)).lower(state, x) \
+        .compile().as_text()
+    assert 0 in hc.donated_params(good)
+    hc.assert_donates(good, [0], "donated fixture")
+
+
 # ---------------------------------------------------------------------------
 # engine contracts
 # ---------------------------------------------------------------------------
@@ -224,3 +244,42 @@ def test_pipeline_boundary_activation_stays_bf16(eight_devices):
     hc.assert_no_host_transfers(hlo, "pipeline stage-0 forward jit")
     hc.assert_no_fp32_collectives(hlo, min_elements=512,
                                   what="pipeline stage-0 forward jit")
+
+
+def test_serving_decode_is_transfer_free_and_donates_pool(eight_devices):
+    """Serving contracts (deepspeed_tpu/serving/): the continuous-
+    batching decode jit (a) never transfers to the host mid-program,
+    (b) DONATES the paged KV pool (input/output alias — steady-state
+    decode is allocation-free), and (c) under batch-axis sharding moves
+    ZERO collective bytes, matching comm_accounting.
+    serving_decode_collectives' placement-semantics claim and the 0-byte
+    budget in tools/comm_budgets.json."""
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.runtime import comm_accounting as ca
+    from deepspeed_tpu.serving.engine import InferenceEngine
+
+    cfg = GPT2Config(vocab_size=64, n_positions=32, n_embd=32, n_layer=2,
+                     n_head=4, dtype=jnp.float32, loss_chunk_tokens=0)
+    model = GPT2Model(cfg)
+    ids = np.random.default_rng(0).integers(0, 64, (2, 4))
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": ids, "labels": ids})
+    nleaves = len(jax.tree_util.tree_leaves(params))
+
+    for shards, mesh in [(1, None),
+                         (2, Mesh(np.asarray(jax.devices()[:2]),
+                                  ("data",)))]:
+        eng = InferenceEngine(model, params, max_slots=2, kv_block_size=8,
+                              prefill_chunk=8, max_blocks_per_seq=4,
+                              shards=shards, mesh=mesh)
+        hlo = eng.decode_hlo()
+        what = f"serving decode (shards={shards})"
+        hc.assert_no_host_transfers(hlo, what)
+        hc.assert_donates(
+            hlo, range(nleaves, nleaves + eng.n_pool_tensors()), what)
+        budget = sum(c.bytes_per_step for c in
+                     ca.serving_decode_collectives(
+                         cfg.n_layer, cfg.n_embd, cfg.vocab_size,
+                         eng.max_slots, tp=1))
+        assert budget == 0
+        assert hc.assert_collective_budget(hlo, budget, what) == 0

@@ -1,0 +1,501 @@
+"""Continuous-batching serving engine (deepspeed_tpu/serving/).
+
+The two load-bearing acceptance properties:
+
+- **Parity**: greedy tokens produced for each request under continuous
+  batching — staggered arrivals, mixed lengths, eviction and
+  chaos-driven cancellation churn — are BIT-IDENTICAL to
+  single-sequence ``generate()`` (the paged pool gathers a wider padded
+  key view, but exact -1e30 masking makes the attention math equal).
+- **Recompile guard**: after ``warmup()``, requests joining / leaving /
+  completing across >= 20 decode steps trigger ZERO new XLA
+  compilations (CompilationCounter hook) — the decode program is ONE
+  fixed-shape jit with slot masking.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.models.generation import generate
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.runtime.resilience import chaos
+from deepspeed_tpu.runtime.resilience.watchdog import TrainingWatchdog
+from deepspeed_tpu.serving.engine import InferenceEngine
+from deepspeed_tpu.serving.kv_cache import PagedKVPool
+from deepspeed_tpu.serving.metrics import CompilationCounter, ServingMetrics
+from deepspeed_tpu.serving.scheduler import Request, Scheduler
+
+
+@pytest.fixture(scope="module")
+def toy():
+    cfg = GPT2Config(vocab_size=97, n_positions=64, n_embd=32, n_layer=2,
+                     n_head=4, dtype=jnp.float32, loss_chunk_tokens=0)
+    model = GPT2Model(cfg)
+    ids = np.random.default_rng(0).integers(0, 97, (2, 8))
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": ids, "labels": ids})
+    refs = {}
+
+    def ref(prompt, max_new):
+        key = (tuple(int(t) for t in prompt), max_new)
+        if key not in refs:
+            refs[key] = generate(model, params,
+                                 np.asarray(prompt, np.int32)[None],
+                                 max_new_tokens=max_new)[0]
+        return refs[key]
+
+    return model, params, ref
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("kv_block_size", 4)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("max_blocks_per_seq", 8)
+    return InferenceEngine(model, params, **kw)
+
+
+def _prompts(seed, lens):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 97, n).astype(np.int32) for n in lens]
+
+
+# ---------------------------------------------------------------------------
+# parity (acceptance)
+# ---------------------------------------------------------------------------
+
+def test_parity_staggered_mixed_lengths(toy):
+    """Greedy continuous batching == single-sequence generate(), with
+    arrivals staggered across steps and mixed prompt/output lengths."""
+    model, params, ref = toy
+    eng = _engine(model, params)
+    prompts = _prompts(1, (5, 11, 3, 9))
+    maxnew = [6, 9, 12, 5]
+    rids = []
+    for p, m in zip(prompts, maxnew):
+        rids.append(eng.submit(p, max_new_tokens=m))
+        eng.step()                       # stagger arrivals
+        eng.step()
+    res = eng.serve(max_steps=500)
+    for rid, p, m in zip(rids, prompts, maxnew):
+        np.testing.assert_array_equal(res[rid]["tokens"], ref(p, m))
+    rep = eng.serving_report()
+    assert rep["requests"]["completed"] == 4
+    assert rep["ttft_s"]["mean"] is not None
+    assert rep["throughput"]["tokens_per_slot_step"] > 0
+
+
+def test_parity_under_eviction_churn(toy):
+    """A pool too small for both sequences forces preemption; the evicted
+    request re-prefills prompt+generated and must still match
+    single-sequence generate() bit for bit."""
+    model, params, ref = toy
+    eng = _engine(model, params, max_slots=2, kv_blocks=9)
+    prompts = _prompts(2, (9, 10))
+    rids = [eng.submit(p, max_new_tokens=12) for p in prompts]
+    res = eng.serve(max_steps=500)
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(res[rid]["tokens"], ref(p, 12))
+    assert eng.serving_report()["requests"]["evictions"] >= 1, \
+        "pool sizing failed to exercise eviction"
+
+
+def test_parity_under_chaos_cancellation(toy):
+    """chaos.arm(cancel_request_every=N) drives request cancellation
+    through the scheduler; surviving requests stay bit-identical and the
+    cancelled ones report partial tokens."""
+    model, params, ref = toy
+    eng = _engine(model, params)
+    prompts = _prompts(3, (5, 11, 3, 9, 6))
+    maxnew = [6, 9, 12, 5, 8]
+    chaos.arm(cancel_request_every=7)
+    try:
+        rids = []
+        for p, m in zip(prompts, maxnew):
+            rids.append(eng.submit(p, max_new_tokens=m))
+            eng.step()
+            eng.step()
+        res = eng.serve(max_steps=500)
+    finally:
+        plan = chaos.active()
+        chaos.disarm()
+    assert any(kind == "cancel_request" for kind, _ in plan.fired)
+    finished = cancelled = 0
+    for rid, p, m in zip(rids, prompts, maxnew):
+        r = res[rid]
+        if r["status"] == "cancelled":
+            cancelled += 1
+            # partial output is a prefix of the reference continuation
+            np.testing.assert_array_equal(
+                r["tokens"], ref(p, m)[:len(r["tokens"])])
+        else:
+            finished += 1
+            np.testing.assert_array_equal(r["tokens"], ref(p, m))
+    assert cancelled >= 1 and finished >= 1
+    assert eng.serving_report()["requests"]["cancelled"] == cancelled
+
+
+def test_parity_eos_early_stop(toy):
+    """A request that hits eos stops early and matches the eos-latched
+    generate() output up to (and including) the first eos."""
+    model, params, ref = toy
+    prompt = _prompts(4, (6,))[0]
+    base = ref(prompt, 10)
+    eos = int(base[len(prompt) + 2])     # appears mid-continuation
+    eng = _engine(model, params)
+    rid = eng.submit(prompt, max_new_tokens=10, eos_token_id=eos)
+    res = eng.serve(max_steps=200)
+    got = res[rid]["tokens"]
+    gen = generate(model, params, prompt[None], max_new_tokens=10,
+                   eos_token_id=eos)[0]
+    stop = len(prompt) + list(gen[len(prompt):]).index(eos) + 1
+    np.testing.assert_array_equal(got, gen[:stop])
+    assert got[-1] == eos
+
+
+# ---------------------------------------------------------------------------
+# recompile guard (acceptance)
+# ---------------------------------------------------------------------------
+
+def test_zero_recompiles_after_warmup(toy):
+    """>= 20 decode steps of join/leave/complete churn compile NOTHING
+    new after warmup, and the decode program is host-transfer-free with
+    the KV pool donated (HLO contracts)."""
+    from tools.graftlint import hlo_contracts as hc
+
+    model, params, ref = toy
+    eng = _engine(model, params)
+    eng.warmup()
+    prompts = _prompts(5, (5, 11, 3, 9, 6, 4, 7))
+    maxnew = [6, 9, 12, 5, 8, 7, 10]
+    with CompilationCounter() as cc:
+        rids = []
+        for p, m in zip(prompts, maxnew):
+            rids.append(eng.submit(p, max_new_tokens=m))
+            eng.step()
+            eng.step()
+        eng.serve(max_steps=500)
+    assert eng.metrics.decode_steps >= 20, eng.metrics.decode_steps
+    assert cc.count == 0, \
+        f"{cc.count} XLA compilations during steady-state churn"
+    for rid, p, m in zip(rids, prompts, maxnew):
+        np.testing.assert_array_equal(eng.results[rid]["tokens"],
+                                      ref(p, m))
+    hlo = eng.decode_hlo()
+    hc.assert_no_host_transfers(hlo, "serving decode step")
+    nleaves = len(jax.tree_util.tree_leaves(params))
+    pool_params = range(nleaves, nleaves + eng.n_pool_tensors())
+    hc.assert_donates(hlo, pool_params, "serving decode step")
+
+
+def test_warmup_covers_multichunk_prompts_on_small_capacity(toy):
+    """Regression (review round 1): capacity too small for
+    chunk+bucket+2 warmup prompts must still compile the NON-final
+    prefill variant — a post-warmup prompt longer than prefill_chunk
+    used to pay a steady-state compile."""
+    model, params, ref = toy
+    eng = InferenceEngine(model, params, max_slots=2, kv_block_size=4,
+                          prefill_chunk=16, max_blocks_per_seq=5)
+    assert eng.capacity_per_seq == 20    # chunk+4+2 > 20 for every bucket
+    eng.warmup()
+    prompt = _prompts(14, (17,))[0]      # needs a non-final chunk
+    with CompilationCounter() as cc:
+        rid = eng.submit(prompt, max_new_tokens=3)
+        eng.serve(max_steps=100)
+    assert cc.count == 0, \
+        f"{cc.count} compiles for an admissible post-warmup prompt"
+    np.testing.assert_array_equal(eng.results[rid]["tokens"],
+                                  ref(prompt, 3))
+
+
+def test_steady_state_pool_is_updated_in_place(toy):
+    """Donation proof at the array level: after a decode step the
+    PREVIOUS pool buffers are deleted (consumed in place), not copied."""
+    model, params, _ = toy
+    eng = _engine(model, params)
+    eng.submit(_prompts(6, (5,))[0], max_new_tokens=4)
+    eng.step()                            # prefill
+    before = eng.pool.tensors.arrays
+    eng.step()                            # decode consumes the pool
+    assert all(t.is_deleted() for t in before)
+
+
+# ---------------------------------------------------------------------------
+# sharded decode
+# ---------------------------------------------------------------------------
+
+def test_sharded_decode_parity_and_zero_collectives(toy, eight_devices):
+    """Batch-axis sharding over a 2-device mesh: identical greedy tokens,
+    and the compiled decode program moves ZERO collective bytes (the
+    placement-semantics claim priced in comm_budgets.json)."""
+    from jax.sharding import Mesh
+    from tools.graftlint import hlo_contracts as hc
+
+    model, params, ref = toy
+    mesh = Mesh(np.array(eight_devices[:2]), ("data",))
+    eng = _engine(model, params, max_slots=4, shards=2, mesh=mesh)
+    prompts = _prompts(7, (5, 11, 7, 4))
+    rids = [eng.submit(p, max_new_tokens=7) for p in prompts]
+    res = eng.serve(max_steps=500)
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(res[rid]["tokens"], ref(p, 7))
+    hlo = eng.decode_hlo()
+    assert hc.collective_bytes(hlo) == 0, [
+        c.line for c in hc.collective_ops(hlo)]
+    hc.assert_no_host_transfers(hlo, "sharded serving decode")
+
+
+def test_decode_collectives_accounting():
+    from deepspeed_tpu.runtime import comm_accounting as ca
+
+    assert ca.serving_decode_collectives(24, 1024, 50304, 8, tp=1) == []
+    tp = ca.serving_decode_collectives(24, 1024, 50304, 8, tp=8,
+                                       act_dtype="bfloat16")
+    assert len(tp) == 24 * 2 + 1
+    assert all(c.op == "all-reduce" for c in tp)
+    # 2(w-1)/w * n * s per activation all-reduce
+    act = [c for c in tp if c.name.startswith("decode_ar:attn_out")][0]
+    assert act.bytes_per_device == int(2 * (7 / 8) * 8 * 1024 * 2)
+
+
+# ---------------------------------------------------------------------------
+# int8 KV
+# ---------------------------------------------------------------------------
+
+def test_int8_kv_arms_and_serves(toy):
+    model, params, _ = toy
+    eng = _engine(model, params, quantize_kv=True)
+    assert eng.pool.quantized
+    assert eng.n_pool_tensors() == 4
+    prompt = _prompts(8, (6,))[0]
+    rid = eng.submit(prompt, max_new_tokens=8)
+    res = eng.serve(max_steps=200)
+    toks = res[rid]["tokens"]
+    assert toks.shape == (14,) and toks.max() < 97
+    np.testing.assert_array_equal(toks[:6], prompt)
+
+
+def test_int8_kv_disarms_when_unprofitable(caplog):
+    """bf16 pool with head_dim <= 4: the f32 scale costs more than int8
+    saves — must warn DISARMED and serve full precision."""
+    import logging
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    cfg = GPT2Config(vocab_size=32, n_positions=32, n_embd=8, n_layer=1,
+                     n_head=2, dtype=jnp.bfloat16, loss_chunk_tokens=0)
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            pool = PagedKVPool(cfg, num_blocks=4, block_size=4,
+                               quantize_kv=True)
+    finally:
+        ds_logger.propagate = False
+    assert not pool.quantized
+    assert any("DISARMED" in r.message for r in caplog.records)
+    assert pool.tensors.k.dtype == jnp.bfloat16
+
+
+# ---------------------------------------------------------------------------
+# scheduler / allocator units (no model)
+# ---------------------------------------------------------------------------
+
+def _req(rid, n=4, prio=0, max_new=4):
+    return Request(rid=rid, prompt=np.zeros(n, np.int32),
+                   max_new_tokens=max_new, priority=prio)
+
+
+def test_scheduler_priority_then_fcfs():
+    s = Scheduler(2)
+    for rid, prio in [(0, 1), (1, 0), (2, 1), (3, 0)]:
+        s.submit(_req(rid, prio=prio))
+    order = []
+    while True:
+        r = s.start_admission()
+        if r is None:
+            break
+        order.append(r.rid)
+        s.promote(r)
+    # both slots fill in priority order; FCFS within a class
+    assert order == [1, 3]
+    assert s.peek_waiting().rid == 0
+
+
+def test_scheduler_victim_policy():
+    s = Scheduler(3)
+    for rid, prio in [(0, 0), (1, 1), (2, 1)]:
+        s.submit(_req(rid, prio=prio))
+        r = s.start_admission()
+        s.promote(r)
+    newcomer = _req(9, prio=0)
+    # admission: only strictly-less-important victims; youngest first
+    v = s.victim(for_req=newcomer, admission=True)
+    assert v.rid == 2
+    # growth of a prio-1 runner may preempt its own class but not rid 0
+    v = s.victim(for_req=s.running[1], admission=False)
+    assert v.rid == 2
+    # a prio-0 grower with only itself and less-important peers
+    v = s.victim(for_req=s.running[0], admission=False)
+    assert v.rid == 2
+    # shard filter
+    assert s.victim(for_req=newcomer, admission=True, shard=3) is None
+
+
+def test_scheduler_static_gate_drains_between_batches():
+    s = Scheduler(2, policy="static")
+    for rid in range(4):
+        s.submit(_req(rid))
+    a = s.start_admission(); s.promote(a)
+    b = s.start_admission(); s.promote(b)
+    assert {a.rid, b.rid} == {0, 1}
+    # batch formed: the gate closes until the engine drains
+    assert s.start_admission() is None
+    s.finish(a)
+    s.on_drained()
+    assert s.start_admission() is None, "gate must stay shut mid-batch"
+    s.finish(b)
+    s.on_drained()
+    c = s.start_admission()
+    assert c is not None and c.rid == 2
+
+
+def test_scheduler_static_budget_restored_on_dropped_prefill():
+    """A prefill the engine drops (pool pressure) hands its batch budget
+    back — repeated drop/re-admit cycles must not shrink the batch."""
+    s = Scheduler(2, policy="static")
+    for rid in range(3):
+        s.submit(_req(rid))
+    a = s.start_admission()
+    s.drop_prefill(a, requeue=True)       # engine couldn't fit it
+    a2 = s.start_admission()
+    assert a2.rid == a.rid                # FCFS: same request retries
+    s.promote(a2)
+    b = s.start_admission()
+    assert b is not None, "budget leaked: batch closed after 1 member"
+    s.promote(b)
+    assert s.start_admission() is None    # budget of 2 now spent
+
+
+def test_admission_spreads_across_shard_pools(toy, eight_devices):
+    """Slot placement follows pool pressure: with 2 shards, the first
+    two admissions land on DIFFERENT shards (most-free-blocks ranking),
+    not both on shard 0."""
+    from jax.sharding import Mesh
+
+    model, params, _ = toy
+    mesh = Mesh(np.array(eight_devices[:2]), ("data",))
+    eng = _engine(model, params, max_slots=4, shards=2, mesh=mesh)
+    r0 = eng.submit(_prompts(12, (5,))[0], max_new_tokens=16)
+    eng.step()                            # admit+prefill r0
+    r1 = eng.submit(_prompts(13, (5,))[0], max_new_tokens=16)
+    eng.step()                            # admit+prefill r1
+    shards = {rid: eng.pool._shard_of[rid] for rid in (r0, r1)}
+    assert shards[r0] != shards[r1], shards
+    eng.serve(max_steps=200)
+
+
+def test_pool_allocator_occupancy_and_fragmentation():
+    cfg = GPT2Config(vocab_size=32, n_positions=64, n_embd=8, n_layer=1,
+                     n_head=2, dtype=jnp.float32, loss_chunk_tokens=0)
+    pool = PagedKVPool(cfg, num_blocks=8, block_size=4)
+    assert pool.usable_blocks == 7
+    assert pool.alloc(0, 0, 6)           # 2 blocks, 6 positions
+    assert pool.blocks_in_use == 2
+    assert pool.fragmentation() == pytest.approx(1 - 6 / 8)
+    assert pool.alloc(1, 0, 20)          # 5 blocks -> pool full
+    assert not pool.alloc(2, 0, 5), "overcommit must fail cleanly"
+    assert pool.blocks_in_use == 7 and pool.occupancy() == 1.0
+    row = pool.table_row(1, 8)
+    assert (row[:5] > 0).all() and (row[5:] == 0).all()
+    pool.free(0)
+    assert pool.alloc(2, 0, 5)
+    pool.free(1)
+    pool.free(2)
+    assert pool.blocks_in_use == 0 and pool.fragmentation() == 0.0
+
+
+def test_submit_rejects_oversized_requests(toy):
+    model, params, _ = toy
+    eng = _engine(model, params)          # capacity 8 blocks x 4 = 32
+    with pytest.raises(AssertionError, match="capacity"):
+        eng.submit(np.zeros(30, np.int32), max_new_tokens=10)
+
+
+# ---------------------------------------------------------------------------
+# metrics / reporting / watchdog
+# ---------------------------------------------------------------------------
+
+def test_metrics_ttft_tpot_with_fake_clock():
+    t = [0.0]
+    m = ServingMetrics(clock=lambda: t[0])
+    m.record_submit(7)
+    t[0] = 1.5
+    m.record_token(7)                     # TTFT = 1.5
+    t[0] = 2.0
+    m.record_token(7)
+    t[0] = 2.5
+    m.record_token(7)                     # 2 intervals over 1.0s
+    m.record_finish(7)
+    m.record_step(queue_depth=2, running=1, slots=4, occupancy=0.5,
+                  fragmentation=0.25, decoded=True)
+    rep = m.report()
+    assert rep["ttft_s"]["mean"] == pytest.approx(1.5)
+    assert rep["tpot_s"] == pytest.approx(0.5)
+    assert rep["requests"]["completed"] == 1
+    assert rep["queue_depth"]["max"] == 2
+    assert rep["kv_pool"]["occupancy_max"] == pytest.approx(0.5)
+
+
+def test_serving_report_and_last_metrics(toy):
+    model, params, _ = toy
+    eng = _engine(model, params)
+    rid = eng.submit(_prompts(9, (5,))[0], max_new_tokens=4)
+    eng.serve(max_steps=100)
+    rep = eng.serving_report()
+    assert rep["config"]["max_slots"] == 3
+    assert rep["tokens"]["generated"] == 4
+    assert 0.0 <= rep["kv_pool"]["occupancy_max"] <= 1.0
+    assert rep["kv_pool"]["now"]["blocks_in_use"] == 0   # all freed
+    assert eng._last_metrics["step"] == eng.metrics.steps
+    assert eng.results[rid]["status"] == "finished"
+
+
+def test_watchdog_heartbeats_every_step(toy):
+    model, params, _ = toy
+    beats = []
+    wd = TrainingWatchdog(stall_timeout=1e9,
+                          clock=lambda: beats.append(1) or 0.0)
+    eng = _engine(model, params, watchdog=wd)
+    eng.submit(_prompts(10, (4,))[0], max_new_tokens=3)
+    eng.serve(max_steps=100)
+    wd.heartbeat()
+    assert wd.last_progress_time is not None
+    assert len(beats) >= eng.metrics.steps
+
+
+# ---------------------------------------------------------------------------
+# continuous vs static throughput (the serve_bench claim, in miniature)
+# ---------------------------------------------------------------------------
+
+def test_continuous_beats_static_batching(toy):
+    """Mixed output lengths: static batching burns slot-steps running
+    every batch to its slowest member; continuous refills freed lanes
+    next step.  >= 1.3x tokens per slot-step (the deterministic
+    hardware-time proxy tools/serve_bench.py reports)."""
+    model, params, _ = toy
+    rng = np.random.default_rng(11)
+    prompts = _prompts(11, rng.integers(4, 8, 16))
+    maxnew = [2 if i % 2 == 0 else 24 for i in range(16)]
+
+    def run(policy):
+        eng = _engine(model, params, max_slots=4, policy=policy)
+        for p, m in zip(prompts, maxnew):
+            eng.submit(p, max_new_tokens=m)
+        eng.serve(max_steps=1000)
+        rep = eng.serving_report()
+        assert rep["requests"]["completed"] == len(prompts)
+        return rep["throughput"]["tokens_per_slot_step"]
+
+    cont, static = run("continuous"), run("static")
+    assert cont >= 1.3 * static, (cont, static)

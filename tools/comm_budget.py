@@ -81,6 +81,17 @@ CONFIGS = {
         pipe=4, gas=8, boundary_elems=_P2P_ELEMS),
     "gpt2-350m-ish/pipe4/gas8/p2p-interleaved-v2": dict(
         pipe=4, gas=8, boundary_elems=_P2P_ELEMS, virtual_stages=2),
+    # serving decode (one continuous-batching token step, batch=8).
+    # Batch-axis sharding is collective-FREE by placement (every decode op
+    # is slot-uniform; the serving HLO contract pins the compiled program
+    # to 0 bytes) — budgeted at 0 so any collective sneaking into the
+    # decode path fails here too.  The tensor-parallel alternative pays
+    # 2 activation all-reduces per layer + the logits all-reduce per
+    # TOKEN; keeping it in the table makes the trade legible.
+    "serving/gpt2-350m-ish/decode-b8/batch-sharded-dp8": dict(
+        serving=True, batch=8, tp=1),
+    "serving/gpt2-350m-ish/decode-b8/tensor-sharded-tp8": dict(
+        serving=True, batch=8, tp=8),
 }
 
 
@@ -88,6 +99,18 @@ def compute_volumes():
     """{config name: {total/grad/param/inter bytes per step}}."""
     out = {}
     for name, cfg in CONFIGS.items():
+        if cfg.get("serving"):
+            colls = ca.serving_decode_collectives(
+                _L, _H, _V, cfg["batch"], tp=cfg.get("tp", 1),
+                act_dtype=cfg.get("act_dtype", "bfloat16"))
+            out[name] = {
+                "total_bytes_per_step":
+                    sum(c.bytes_per_step for c in colls),
+                "decode_allreduce_bytes_per_step":
+                    sum(c.bytes_per_step for c in colls
+                        if c.op == "all-reduce"),
+            }
+            continue
         if "pipe" in cfg:
             colls = ca.pipe_p2p_collectives(
                 cfg["boundary_elems"], cfg["gas"], stages=cfg["pipe"],

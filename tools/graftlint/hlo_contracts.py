@@ -16,7 +16,12 @@ and asserts a contract the runtime's performance claims depend on:
   complement of tools/comm_budget.py's config-level regression guard;
 - ``entry_output_dtypes``: the compiled entry signature's result dtypes,
   for pinning boundary-transfer payload dtypes (pipeline activations
-  must cross stages in the compute dtype).
+  must cross stages in the compute dtype);
+- ``donated_params``/``assert_donates``: the module-header
+  input/output-alias table — XLA's rendering of jit donation.  A hot
+  path that claims in-place state update (the training micro-step's
+  TrainState, the serving engine's KV pool) must actually alias its
+  buffers, or every step silently pays a full-state copy.
 
 Wired as tier-1 tests in tests/unit/test_hlo_contracts.py; deterministic
 on the CPU mesh — no accelerator needed.
@@ -148,6 +153,45 @@ def assert_collective_budget(hlo_text: str, budget_bytes: int,
             f"the analytic budget {budget_bytes} (x{slack} slack = "
             f"{allowed}); unbudgeted collective sneaked in?\n  {ops}")
     return total
+
+
+def donated_params(hlo_text: str) -> set:
+    """Parameter numbers aliased to outputs (jax donation), parsed from
+    the module header's ``input_output_alias={ {0}: (2, {}, may-alias) }``
+    table — entries map output tuple index -> (param number, param index
+    path, kind)."""
+    start = hlo_text.find("input_output_alias={")
+    if start < 0:
+        return set()
+    # balanced-brace scan: entries themselves contain nested {}
+    i = hlo_text.index("{", start)
+    depth, j = 0, i
+    for j in range(i, len(hlo_text)):
+        if hlo_text[j] == "{":
+            depth += 1
+        elif hlo_text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+    body = hlo_text[i + 1:j]
+    return {int(m.group(1))
+            for m in re.finditer(r"\}\s*:\s*\((\d+)", body)}
+
+
+def assert_donates(hlo_text: str, param_indices, what: str = "jit") -> None:
+    """Every parameter in ``param_indices`` must be input/output-aliased:
+    the caller's donate_argnums actually became in-place buffer reuse.
+    (XLA drops an alias when dtype/shape/layout of input and output
+    disagree — e.g. a dtype cast on the donated state — which turns the
+    'allocation-free' step into a copy per invocation.)"""
+    got = donated_params(hlo_text)
+    missing = sorted(set(int(p) for p in param_indices) - got)
+    if missing:
+        raise HloContractError(
+            f"HLO contract: {what} must donate parameter(s) {missing} "
+            f"(input/output alias), but the compiled module only aliases "
+            f"{sorted(got) or 'none'} — the 'in-place' update is paying "
+            f"a full copy per call")
 
 
 def entry_output_dtypes(hlo_text: str) -> Optional[List[str]]:

@@ -32,17 +32,27 @@ import re
 
 from ..core import Finding, Rule, call_name, register
 
-# files whose step-driving loops are hot paths (repo-relative)
+# files whose step-driving loops are hot paths (repo-relative).  The
+# serving engine/scheduler are held to the same bar as the training
+# engines: a decode step may fetch its token batch ONCE (straight-line
+# device_get after dispatch) but a device sync inside any per-slot /
+# per-request loop serializes every running sequence against the host.
 HOT_FILES = {
     "deepspeed_tpu/runtime/engine.py",
     "deepspeed_tpu/runtime/pipe/engine.py",
+    "deepspeed_tpu/serving/engine.py",
+    "deepspeed_tpu/serving/scheduler.py",
+    "deepspeed_tpu/serving/kv_cache.py",
 }
 HOT_FN_RE = re.compile(
     r"^(train_batch|eval_batch|forward|backward|step"
-    r"|_take_model_step\w*|_exec_\w+|_run_\w+)$")
+    r"|_take_model_step\w*|_exec_\w+|_run_\w+"
+    r"|serve\w*|submit|cancel|_decode_\w+|_prefill_\w+"
+    r"|_on_new_token|_ensure_blocks|warmup"
+    r"|alloc|free|table_row)$")
 # benchmark drivers: every loop is (or brackets) a timed region — a sync
 # per iteration pollutes the measured step time with transfer latency
-BENCH_FILES = {"bench.py", "tools/pipe_bench.py"}
+BENCH_FILES = {"bench.py", "tools/pipe_bench.py", "tools/serve_bench.py"}
 
 SYNC_METHOD_ATTRS = {"item", "block_until_ready"}
 SYNC_FN_NAMES = {"device_get", "block_until_ready"}

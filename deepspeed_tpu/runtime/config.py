@@ -293,6 +293,8 @@ class DeepSpeedResilienceConfig:
                                          RESILIENCE_VERIFY_ON_LOAD_DEFAULT))
         self.auto_resume = bool(d.get(RESILIENCE_AUTO_RESUME,
                                       RESILIENCE_AUTO_RESUME_DEFAULT))
+        self.async_commit = bool(d.get(RESILIENCE_ASYNC_COMMIT,
+                                       RESILIENCE_ASYNC_COMMIT_DEFAULT))
         self.watchdog_enabled = bool(wd.get(WATCHDOG_ENABLED,
                                             WATCHDOG_ENABLED_DEFAULT))
         self.watchdog_max_skipped_steps = int(
@@ -330,6 +332,19 @@ def get_pipeline_config(param_dict):
         raise ValueError(
             f"pipeline.{PIPELINE_VIRTUAL_STAGES} must be >= 1, "
             f"got {virtual_stages}")
+    stashing = d.get(PIPELINE_STASH, PIPELINE_STASH_DEFAULT)
+    if isinstance(stashing, str):
+        stashing = stashing.lower()
+    if stashing not in (True, False, "auto"):
+        raise ValueError(
+            f'pipeline.{PIPELINE_STASH} must be true, false or "auto", '
+            f"got {stashing!r}")
+    stash_budget = int(d.get(PIPELINE_STASH_BUDGET,
+                             PIPELINE_STASH_BUDGET_DEFAULT))
+    if stash_budget < 0:
+        raise ValueError(
+            f"pipeline.{PIPELINE_STASH_BUDGET} must be >= 0 bytes "
+            f"(0 = unbounded), got {stash_budget}")
     return {
         PIPELINE_STAGES: d.get(PIPELINE_STAGES, PIPELINE_STAGES_DEFAULT),
         PIPELINE_PARTITION: d.get(PIPELINE_PARTITION, PIPELINE_PARTITION_DEFAULT),
@@ -339,6 +354,8 @@ def get_pipeline_config(param_dict):
             PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT),
         PIPELINE_SCHEDULE: schedule,
         PIPELINE_VIRTUAL_STAGES: virtual_stages,
+        PIPELINE_STASH: stashing,
+        PIPELINE_STASH_BUDGET: stash_budget,
     }
 
 

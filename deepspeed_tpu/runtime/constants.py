@@ -188,6 +188,12 @@ RESILIENCE_VERIFY_ON_LOAD = "verify_on_load"    # manifest replay before load
 RESILIENCE_VERIFY_ON_LOAD_DEFAULT = True
 RESILIENCE_AUTO_RESUME = "auto_resume"          # default for load_checkpoint
 RESILIENCE_AUTO_RESUME_DEFAULT = False
+# async checkpoint commit: payload write + streaming hash + fsync on a
+# background commit thread; only the atomic rename + latest-pointer
+# update stay on the training thread (emergency checkpoints are always
+# synchronous).  Back-pressure: at most one commit in flight.
+RESILIENCE_ASYNC_COMMIT = "async_commit"
+RESILIENCE_ASYNC_COMMIT_DEFAULT = False
 
 RESILIENCE_WATCHDOG = "watchdog"
 WATCHDOG_ENABLED = "enabled"
@@ -216,3 +222,15 @@ PIPELINE_SCHEDULE = "schedule"          # "1f1b" | "interleaved" | "zb-h1"
 PIPELINE_SCHEDULE_DEFAULT = "1f1b"
 PIPELINE_VIRTUAL_STAGES = "virtual_stages"  # model chunks per stage (>=1)
 PIPELINE_VIRTUAL_STAGES_DEFAULT = 1
+# zb-h1 activation stashing: run the forward once per (chunk, micro) and
+# stash its vjp residuals so dgrad/wgrad skip the forward recompute.
+# "auto" arms it whenever the zb-h1 schedule is armed (and the budget
+# fits); True insists (still DISARMS loudly on blockers); False keeps
+# the remat-honest split backward.
+PIPELINE_STASH = "activation_stashing"
+PIPELINE_STASH_DEFAULT = "auto"
+# peak stash bytes allowed PER STAGE (0 = unbounded). When the analytic
+# peak (peak_live_stash x per-micro stash bytes) exceeds this on any
+# stage, stashing DISARMS (falls back to remat) naming the stage.
+PIPELINE_STASH_BUDGET = "stash_budget"
+PIPELINE_STASH_BUDGET_DEFAULT = 0

@@ -152,6 +152,15 @@ class DeepSpeedEngine:
         self._resilience = res
         self._consecutive_skips = 0
         self._last_ckpt_dir = None
+        self._last_metrics = None
+        # async checkpoint commit (resilience.async_commit): at most ONE
+        # in flight; the background thread owns write+hash+fsync, the
+        # training thread owns the rename (+ latest) via
+        # _finalize_pending_commit
+        self._pending_commit = None
+        self._pending_commit_info = None
+        self._ckpt_foreground_ms = 0.0
+        self._ckpt_metrics = None
         self._watchdog = None
         if res.watchdog_enabled:
             from deepspeed_tpu.runtime.resilience.watchdog import \
@@ -2311,6 +2320,11 @@ class DeepSpeedEngine:
         (scale + streak) into _last_metrics, and feeds the watchdog.  On an
         abort verdict an emergency checkpoint is written before the
         WatchdogAlarm propagates."""
+        # async checkpoint commit: publish (rename + latest) at the first
+        # step boundary after the background seal lands — the commit
+        # becomes visible without waiting for the next save/wait call
+        if self._pending_commit is not None:
+            self._finalize_pending_commit(wait=False)
         if overflow is not None:
             self._consecutive_skips = \
                 self._consecutive_skips + 1 if overflow else 0
@@ -2321,6 +2335,14 @@ class DeepSpeedEngine:
                 metrics = dict(self._last_metrics)
                 metrics["consecutive_skips"] = self._consecutive_skips
                 self._last_metrics = metrics
+        if self._ckpt_metrics is not None and \
+                isinstance(self._last_metrics, dict) \
+                and "ckpt_commit_ms_foreground" not in self._last_metrics:
+            metrics = dict(self._last_metrics)
+            metrics.update(self._ckpt_metrics)
+            metrics["ckpt_commit_pending"] = \
+                int(self._pending_commit is not None)
+            self._last_metrics = metrics
         if self._watchdog is None:
             return
         from deepspeed_tpu.runtime.resilience.watchdog import WatchdogAlarm
@@ -2361,11 +2383,15 @@ class DeepSpeedEngine:
             # save_latest=False + the manifest flag: the aborting state may
             # itself be the problem (NaN params on a non-fp16 divergence),
             # so restarts must prefer the last healthy checkpoint — the
-            # emergency tag is kept for postmortem and as a last resort
+            # emergency tag is kept for postmortem and as a last resort.
+            # async_commit=False: the process is about to die on the
+            # WatchdogAlarm — a background commit thread would die with
+            # it, so the final snapshot commits synchronously
             self.save_checkpoint(save_dir,
                                  tag=f"emergency_step{self.global_steps}",
                                  save_latest=False,
-                                 manifest_meta={"emergency": True})
+                                 manifest_meta={"emergency": True},
+                                 async_commit=False)
         except Exception as e:
             # best-effort by definition: whatever the save raises, the
             # caller must still see the WatchdogAlarm, not a ckpt error
@@ -2432,27 +2458,96 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:1279-1597; layout kept similar)
     # ------------------------------------------------------------------
-    def _write_checkpoint_files(self, path, client_state, backend):
-        """Write every payload file of one checkpoint tag into ``path``
-        (the temp dir on the atomic path).  Returns the backend used.
-        Each file write is followed by a chaos hook so fault-injection
-        tests can kill/corrupt the write at any point."""
-        import jax
-
-        from deepspeed_tpu.runtime.resilience import chaos
-
+    def _resolve_ckpt_backend(self, backend):
+        """Concrete payload backend for None/'auto' requests: orbax when
+        available (sharded write with NO host gather — npz would
+        materialize the full TrainState on process 0; a 10B state OOMs
+        the host), npz as the tiny/portable fallback."""
         if backend in (None, "auto"):
-            # orbax by default: sharded write with NO host gather — npz
-            # would materialize the full TrainState on process 0 (a 10B
-            # state OOMs the host); npz stays available for tiny/portable
-            # checkpoints
             try:
                 import orbax.checkpoint  # noqa: F401
 
-                backend = "orbax"
+                return "orbax"
             except ImportError:  # pragma: no cover - orbax is baked in
-                backend = "npz"
+                return "npz"
+        return backend
 
+    def _ckpt_host_snapshot(self, client_state, backend, copy_host=False):
+        """Everything the payload writer needs, resident on HOST memory and
+        owned by the snapshot (device_get'd / copied), so writing can
+        happen on a background thread while training donates and mutates
+        the live state.  Device transfers and host-replication collectives
+        all happen HERE (the foreground), never in the writer.
+        ``copy_host=True`` (async commits) additionally copies mutable
+        host-optimizer buffers; the sync path writes before the next step
+        can mutate them, so it skips the copy."""
+        import jax
+
+        snap = {"backend": backend, "client_state": client_state,
+                "num_leaves": len(jax.tree_util.tree_leaves(self.state)),
+                "flat": None, "off_leaves": None, "opt_step": None}
+        if backend == "npz" and jax.process_index() == 0:
+            host_state = jax.device_get(self.state)
+            snap["flat"], _ = jax.tree_util.tree_flatten(host_state)
+        if self._offload:
+            # shard-local stepping means each process's host arrays are
+            # only authoritative on its own regions: reassemble full
+            # arrays via a device round-trip before rank 0 writes them
+            off_leaves = (self._host_master_flat + self._host_opt["m"]
+                          + self._host_opt["v"])
+            if jax.process_count() > 1:
+                off_leaves = self._replicate_host_leaves(off_leaves)
+            if copy_host:
+                # the host Adam steps these buffers in place; a background
+                # writer must see the snapshot-time values
+                off_leaves = [np.array(l, copy=True) for l in off_leaves]
+            snap["off_leaves"] = off_leaves
+            snap["opt_step"] = self._host_opt["step"]
+        snap["meta"] = {
+            "global_steps": self.global_steps,
+            "micro_steps": self.micro_steps,
+            "skipped_steps": self.skipped_steps,
+            "dp_world_size": self.dp_world_size,
+            "backend": backend,
+            "lr_scheduler": self.lr_scheduler.state_dict()
+            if self.lr_scheduler is not None else None,
+            "client_state": client_state,
+            "num_leaves": snap["num_leaves"],
+        }
+        return snap
+
+    def _write_snapshot_files(self, path, snap):
+        """Write one snapshot's payload files into ``path`` — filesystem
+        work only (safe on the async commit thread).  Each file write is
+        followed by a chaos hook so fault-injection tests can
+        kill/corrupt the write at any point."""
+        import jax
+
+        from deepspeed_tpu.runtime.checkpoint_utils import leaves_to_npz_dict
+        from deepspeed_tpu.runtime.resilience import chaos
+
+        if snap["flat"] is not None:
+            fname = os.path.join(path, "model_states.npz")
+            self._ckpt_savez(fname, **leaves_to_npz_dict(snap["flat"]))
+            chaos.file_written(fname)
+        if jax.process_index() == 0:
+            if snap["off_leaves"] is not None:
+                fname = os.path.join(path, "offload_states.npz")
+                self._ckpt_savez(fname,
+                                 **leaves_to_npz_dict(snap["off_leaves"]),
+                                 opt_step=snap["opt_step"])
+                chaos.file_written(fname)
+            fname = os.path.join(path, "metadata.pkl")
+            with open(fname, "wb") as f:
+                pickle.dump(snap["meta"], f)
+            chaos.file_written(fname)
+
+    def _write_checkpoint_files(self, path, client_state, backend):
+        """Write every payload file of one checkpoint tag into ``path``
+        (the temp dir on the atomic path).  Returns the backend used."""
+        from deepspeed_tpu.runtime.resilience import chaos
+
+        backend = self._resolve_ckpt_backend(backend)
         if backend == "orbax":
             import orbax.checkpoint as ocp
 
@@ -2461,50 +2556,20 @@ class DeepSpeedEngine:
                        self.state)
             ckptr.wait_until_finished()
             chaos.file_written(os.path.join(path, "orbax_state"))
-        num_leaves = len(jax.tree_util.tree_leaves(self.state))
-        if backend == "npz" and jax.process_index() == 0:
-            from deepspeed_tpu.runtime.checkpoint_utils import \
-                leaves_to_npz_dict
-
-            host_state = jax.device_get(self.state)
-            flat, _ = jax.tree_util.tree_flatten(host_state)
-            fname = os.path.join(path, "model_states.npz")
-            self._ckpt_savez(fname, **leaves_to_npz_dict(flat))
-            chaos.file_written(fname)
-        off_leaves = None
-        if self._offload:
-            # shard-local stepping means each process's host arrays are only
-            # authoritative on its own regions: reassemble full arrays via a
-            # device round-trip before rank 0 writes them (save-time only)
-            off_leaves = (self._host_master_flat + self._host_opt["m"]
-                          + self._host_opt["v"])
-            if jax.process_count() > 1:
-                off_leaves = self._replicate_host_leaves(off_leaves)
-        if jax.process_index() == 0:
-            if self._offload:
-                from deepspeed_tpu.runtime.checkpoint_utils import \
-                    leaves_to_npz_dict
-
-                fname = os.path.join(path, "offload_states.npz")
-                self._ckpt_savez(fname, **leaves_to_npz_dict(off_leaves),
-                                 opt_step=self._host_opt["step"])
-                chaos.file_written(fname)
-            meta = {
-                "global_steps": self.global_steps,
-                "micro_steps": self.micro_steps,
-                "skipped_steps": self.skipped_steps,
-                "dp_world_size": self.dp_world_size,
-                "backend": backend,
-                "lr_scheduler": self.lr_scheduler.state_dict()
-                if self.lr_scheduler is not None else None,
-                "client_state": client_state,
-                "num_leaves": num_leaves,
-            }
-            fname = os.path.join(path, "metadata.pkl")
-            with open(fname, "wb") as f:
-                pickle.dump(meta, f)
-            chaos.file_written(fname)
+        self._write_snapshot_files(
+            path, self._ckpt_host_snapshot(client_state, backend))
         return backend
+
+    def _ckpt_snapshot_writer(self, client_state, backend):
+        """(backend, write_fn) for an ASYNC commit: every device->host
+        transfer and mutable-host copy happens NOW on the training
+        thread; ``write_fn(path)`` then only touches the filesystem.
+        ``backend`` must already be resolved and npz-family (the orbax
+        writer gathers from live device state — the arming gate keeps it
+        synchronous)."""
+        snap = self._ckpt_host_snapshot(client_state, backend,
+                                        copy_host=True)
+        return backend, lambda path: self._write_snapshot_files(path, snap)
 
     def _assert_saveable(self):
         assert self.state is not None, \
@@ -2553,7 +2618,8 @@ class DeepSpeedEngine:
         }
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
-                        save_latest=True, backend=None, manifest_meta=None):
+                        save_latest=True, backend=None, manifest_meta=None,
+                        async_commit=None):
         """backend: None/'auto' (orbax when multi-process — sharded write
         without gathering, the fix for replicate-on-save OOM), 'npz'
         (single-file), or 'orbax' (sharded; supports world-size-elastic
@@ -2564,9 +2630,24 @@ class DeepSpeedEngine:
         into a temp dir with a checksum manifest, fsync'd, atomically
         renamed into place, and only then is the ``latest`` pointer
         updated — a crash at any point leaves the previous checkpoint
-        intact and loadable."""
+        intact and loadable.
+
+        async_commit (None = resilience.async_commit): snapshot the state
+        to host HERE, then run the payload write + streaming hash + fsync
+        on a background commit thread; only the atomic rename +
+        latest-pointer update stay on the training thread (they run at
+        the next step boundary once the seal lands, or in wait_pending_
+        commit()).  Returns with the tag NOT yet visible; durability
+        semantics and back-pressure are documented in
+        docs/tutorials/fault_tolerance.md."""
+        import time as _time
+
         import jax
 
+        t0 = _time.perf_counter()
+        # back-pressure: at most one commit in flight — a still-running
+        # previous commit is finalized (waiting on its seal) first
+        self._finalize_pending_commit(wait=True)
         self._assert_saveable()
         client_state = client_state or {}
         if tag is None:
@@ -2574,6 +2655,43 @@ class DeepSpeedEngine:
         self._checkpoint_tag_validation(tag)
         res = self._resilience
         self._last_ckpt_dir = save_dir
+        want_async = res.async_commit if async_commit is None \
+            else bool(async_commit)
+        if want_async:
+            want_async = self._arm_async_commit(backend)
+        if want_async:
+            backend_r = self._resolve_ckpt_backend(backend)
+            meta = self._checkpoint_manifest_meta(tag)
+            meta.update(manifest_meta or {})
+            meta["backend"] = backend_r
+            from deepspeed_tpu.runtime.resilience.atomic import (
+                FollowerCommit, PendingCommit, atomic_tag)
+
+            backend_r, write_fn = self._ckpt_snapshot_writer(client_state,
+                                                             backend_r)
+            hb = self._watchdog.heartbeat if self._watchdog is not None \
+                else None
+            if jax.process_count() > 1 and jax.process_index() != 0:
+                # npz-family backends write payload on process 0 only;
+                # peers hold a placeholder so every rank runs the same
+                # finalize choreography (all_agree phases) in lockstep
+                self._pending_commit = FollowerCommit().start()
+            else:
+                commit = atomic_tag(save_dir, tag, meta=meta,
+                                    update_latest=save_latest,
+                                    fsync=res.fsync)
+                self._pending_commit = PendingCommit(
+                    commit, write_fn, heartbeat=hb).start()
+            self._pending_commit_info = {"save_dir": save_dir,
+                                         "tag": str(tag),
+                                         "backend": backend_r}
+            self._ckpt_foreground_ms = (_time.perf_counter() - t0) * 1000.0
+            self._publish_ckpt_metrics()
+            log_dist(f"Async checkpoint commit in flight for tag {tag!r} "
+                     f"(snapshot took "
+                     f"{self._ckpt_foreground_ms:.1f} ms foreground; "
+                     f"write+hash+fsync on the commit thread)", ranks=[0])
+            return True
 
         if not res.atomic_checkpoints:
             # legacy in-place layout (crash window: torn tag, stale latest)
@@ -2595,6 +2713,8 @@ class DeepSpeedEngine:
                      f"non-atomic)", ranks=[0])
             if self._watchdog is not None:
                 self._watchdog.heartbeat()
+            self._ckpt_foreground_ms = (_time.perf_counter() - t0) * 1000.0
+            self._publish_ckpt_metrics()
             return True
 
         from deepspeed_tpu.runtime.resilience.atomic import atomic_tag, \
@@ -2675,7 +2795,144 @@ class DeepSpeedEngine:
             # a large fsync'd save legitimately takes minutes; don't let
             # the stall detector read it as a hung step
             self._watchdog.heartbeat()
+        # a synchronous commit is ALL foreground — the honest comparison
+        # number for the async path's rename-only foreground
+        self._ckpt_foreground_ms = (_time.perf_counter() - t0) * 1000.0
+        self._publish_ckpt_metrics()
         return True
+
+    def _arm_async_commit(self, backend):
+        """True when the async commit path can carry this save; otherwise
+        warn DISARMED (naming every blocker) and fall back to the
+        synchronous commit."""
+        blockers = []
+        if not self._resilience.atomic_checkpoints:
+            blockers.append(
+                "resilience.atomic_checkpoints=false (the legacy in-place "
+                "layout has no seal/publish split to defer)")
+        if self._resolve_ckpt_backend(backend) == "orbax":
+            blockers.append(
+                "orbax backend (its sharded writer gathers from live "
+                "device state; backend='npz' snapshots to host first)")
+        if blockers:
+            log_dist(
+                f"DeepSpeedEngine: async checkpoint commit DISARMED — "
+                f"{'; '.join(blockers)}; committing synchronously",
+                ranks=[0], level=logging.WARNING)
+            return False
+        return True
+
+    def _publish_ckpt_metrics(self):
+        """Mirror commit-path health into _last_metrics (satellite of the
+        _last_metrics idiom): ckpt_commit_ms_foreground is the training-
+        thread time of the last save (snapshot + rename legs for async,
+        the whole commit for sync), ckpt_commit_pending flags an
+        in-flight background seal."""
+        self._ckpt_metrics = {
+            "ckpt_commit_ms_foreground":
+                round(getattr(self, "_ckpt_foreground_ms", 0.0), 3),
+            "ckpt_commit_pending": int(self._pending_commit is not None),
+        }
+        if isinstance(self._last_metrics, dict):
+            metrics = dict(self._last_metrics)
+            metrics.update(self._ckpt_metrics)
+            self._last_metrics = metrics
+
+    def _finalize_pending_commit(self, wait=True):
+        """Foreground leg of an async commit: the atomic rename +
+        latest-pointer-last, then retention GC.  With wait=False (the
+        per-step opportunistic call) an unfinished seal is left in
+        flight.  Returns True when a commit was published.
+
+        Multi-process follows the coordination.all_agree discipline:
+        every rank waits for its local seal, all agree on success,
+        process 0 alone publishes, and all agree again — a failed write
+        on any rank aborts the tag everywhere with the previous
+        checkpoint intact."""
+        import time as _time
+
+        import jax
+
+        pending = self._pending_commit
+        if pending is None:
+            return False
+        multi = jax.process_count() > 1
+        if not wait:
+            ready = pending.ready()
+            if multi:
+                # the publish involves collectives: every rank must take
+                # it at the same step, so readiness itself is agreed
+                from deepspeed_tpu.runtime.resilience.coordination import \
+                    all_agree
+
+                ready, _ = all_agree(ready)
+            if not ready:
+                return False
+        info = self._pending_commit_info
+        res = self._resilience
+        t0 = _time.perf_counter()
+        try:
+            if multi:
+                from deepspeed_tpu.runtime.resilience.coordination import \
+                    all_agree
+
+                pending.wait()
+                agreed, n_failed = all_agree(pending.error is None)
+                if not agreed:
+                    if pending.error is not None:
+                        pending.finalize()  # raises the local error
+                    raise RuntimeError(
+                        f"async checkpoint write for tag "
+                        f"{info['tag']!r} failed on {n_failed} peer "
+                        f"process(es); tag aborted, previous checkpoint "
+                        f"left intact")
+                commit_err = None
+                try:
+                    pending.finalize()  # FollowerCommit no-ops off-leader
+                except BaseException as e:
+                    commit_err = e
+                agreed, n_failed = all_agree(commit_err is None)
+                if commit_err is not None:
+                    raise commit_err
+                if not agreed:
+                    raise RuntimeError(
+                        f"async checkpoint publish for tag "
+                        f"{info['tag']!r} failed on {n_failed} peer "
+                        f"process(es)")
+            else:
+                pending.finalize()
+        finally:
+            self._pending_commit = None
+            self._pending_commit_info = None
+            self._ckpt_foreground_ms = \
+                getattr(self, "_ckpt_foreground_ms", 0.0) \
+                + (_time.perf_counter() - t0) * 1000.0
+            self._publish_ckpt_metrics()
+        from deepspeed_tpu.runtime.resilience import chaos
+        from deepspeed_tpu.runtime.resilience.atomic import gc_tags
+
+        # kill window between rename and GC: the tag is already durable
+        # and visible — chaos proves auto-resume lands on it
+        chaos.point("before_gc")
+        if jax.process_index() == 0 and res.keep_checkpoint_tags > 0:
+            gc_tags(info["save_dir"], res.keep_checkpoint_tags,
+                    protect={info["tag"]})
+        if self._watchdog is not None:
+            self._watchdog.heartbeat()
+        log_dist(f"Committed async checkpoint "
+                 f"{os.path.join(info['save_dir'], info['tag'])} "
+                 f"(backend={info['backend']}, atomic)", ranks=[0])
+        return True
+
+    def wait_pending_commit(self):
+        """Block until any in-flight async checkpoint commit is fully
+        published (rename + latest + GC); True if one was.  Re-raises a
+        failed background write (previous checkpoint left intact)."""
+        return self._finalize_pending_commit(wait=True)
+
+    def pending_commit(self):
+        """True while an async checkpoint commit is still in flight."""
+        return self._pending_commit is not None
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True,
@@ -2694,6 +2951,10 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime.resilience import atomic as atomic_lib
         from deepspeed_tpu.runtime.resilience.atomic import CheckpointCorrupt
 
+        # an in-flight async commit must land (or fail) before its tag can
+        # be a resume candidate — and before a restore invalidates the
+        # snapshot's meaning
+        self._finalize_pending_commit(wait=True)
         res = self._resilience
         # a resumed run that aborts before its first save still has a
         # checkpoint home: the watchdog's emergency fallback dir

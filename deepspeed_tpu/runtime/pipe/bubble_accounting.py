@@ -11,19 +11,22 @@ deterministic functions of (schedule, cost model), so schedule wins are
 assertable in tier-1 tests on CPU, the same proof idiom as
 runtime/comm_accounting.py for collective bytes.
 
-The default cost model matches THIS implementation's jits, including the
-zero-bubble remat tax: the fused backward (b=2) is one forward recompute
-(1) plus the combined grad math (1); the split dgrad/wgrad passes each
-re-run the stage forward inside their own jit, so d = w = 1.5 and
-d + w = b + f — ZB-H1 moves MORE total work per micro than the fused
-schedules. Its bubble FRACTION still lands lowest (utilization is high),
-but compare ``makespan`` for throughput: at pipe=4/gas=8 the default
-model gives zb-h1 makespan 36.5 vs 1f1b 33 — under always-remat the
-extra recompute outweighs the bubble it fills (M*f extra work vs a
-constant (S-1)(f+b-(f+d-w)) saving), matching the CPU-mesh measurement
-in BENCH_NOTES. Passing dgrad=1.0, wgrad=1.0 models the ZB paper's
-activation-stashing variant (no recompute in either pass), the future
-optimization that makes zb-h1 a genuine throughput win. With f == b
+The default cost model matches THIS implementation's jits. A schedule
+compiled WITHOUT stash slots pays the zero-bubble remat tax: the fused
+backward (b=2) is one forward recompute (1) plus the combined grad math
+(1); the split dgrad/wgrad passes each re-run the stage forward inside
+their own jit, so d = w = 1.5 and d + w = b + f — remat ZB-H1 moves MORE
+total work per micro than the fused schedules. Its bubble FRACTION still
+lands lowest (utilization is high), but compare ``makespan`` for
+throughput: at pipe=4/gas=8 that model gives zb-h1 makespan 36.5 vs
+1f1b 33 — under always-remat the extra recompute outweighs the bubble it
+fills (M*f extra work vs a constant (S-1)(f+b-(f+d-w)) saving), matching
+the CPU-mesh measurement in BENCH_NOTES. A schedule compiled with
+``stash=True`` (bounded activation stashing — the engine runs the
+forward once and both split passes consume its stashed vjp residuals)
+defaults to ``CostModel.stash()`` (d = w = 1, d + w = b): zb-h1 becomes
+a genuine throughput win, makespan 27 vs 33 at the same point, paid for
+in stash memory (``peak_live_stash`` per stage). With f == b
 (``CostModel.equal_fwd_bwd()``) the plain 1F1B simulation reproduces the
 closed form (S-1)/(M+S-1) exactly (the round-5 BENCH_NOTES numbers:
 0.20 at pipe=2, 0.43 at pipe=4, gas=4).
@@ -66,6 +69,17 @@ class CostModel:
         plus their own recompute (0.5) each, per the same remat rule."""
         return cls(fwd=1.0, bwd=1.0, dgrad=0.75, wgrad=0.75)
 
+    @classmethod
+    def stash(cls):
+        """d == w == 1 — the activation-STASHING variant (arXiv
+        2401.10241's assumption): the forward runs ONCE and saves its vjp
+        residuals, so neither split pass recomputes it and
+        d + w == b == 2 (no extra total work vs the fused backward).
+        This is the default model for schedules compiled with
+        ``stash=True`` and the model under which zb-h1 turns from a
+        makespan loss (36.5 vs 33 at pipe=4/gas=8) into a win (27)."""
+        return cls(fwd=1.0, bwd=2.0, dgrad=1.0, wgrad=1.0)
+
 
 @dataclass
 class _StageSim:
@@ -74,6 +88,8 @@ class _StageSim:
     pc: int = 0
     live: int = 0
     peak_live: int = 0
+    stash_live: int = 0
+    peak_stash: int = 0
 
 
 def simulate(compiled, costs: Optional[CostModel] = None) -> dict:
@@ -82,10 +98,19 @@ def simulate(compiled, costs: Optional[CostModel] = None) -> dict:
     Keys: schedule, micro_batches, stages, virtual_stages, makespan,
     busy (per stage), idle_fraction (per stage), bubble_fraction
     (aggregate: 1 - sum(busy) / (stages * makespan)), peak_live_buffers
-    (per stage, activation slots held simultaneously), total_instructions,
+    (per stage, activation slots held simultaneously), peak_live_stash
+    (per stage, stashed-forward residual sets held simultaneously —
+    lifetime ForwardPass -> BackwardWeightPass; all zero unless the
+    schedule was compiled with stash slots), total_instructions,
     p2p_transfers (count of send/recv edges crossed per step).
+
+    With no explicit cost model, a stash-compiled schedule defaults to
+    ``CostModel.stash()`` (no recompute in either split pass) and every
+    other schedule to the remat-honest ``CostModel()`` — the report
+    always prices what the engine actually executes.
     """
-    costs = costs or CostModel()
+    stashed = bool(getattr(compiled, "stash", False))
+    costs = costs or (CostModel.stash() if stashed else CostModel())
     S = compiled.stages
     C = compiled.num_chunks
     # a chunk is ~1/v of a stage's layers, so per-chunk compute scales
@@ -141,9 +166,15 @@ def simulate(compiled, costs: Optional[CostModel] = None) -> dict:
                 c = cost_of(cmd)
                 sim.time += c
                 sim.busy += c
+                if stashed and isinstance(cmd, sched_lib.ForwardPass):
+                    sim.stash_live += 1
+                    sim.peak_stash = max(sim.peak_stash, sim.stash_live)
                 if isinstance(cmd, (sched_lib.BackwardPass,
                                     sched_lib.BackwardWeightPass)):
                     sim.live -= 1
+                    if stashed and isinstance(cmd,
+                                              sched_lib.BackwardWeightPass):
+                        sim.stash_live -= 1
             sim.pc += 1
             progressed = True
         if alldone:
@@ -171,17 +202,21 @@ def simulate(compiled, costs: Optional[CostModel] = None) -> dict:
         "idle_fraction": [1.0 - b / makespan for b in busy],
         "bubble_fraction": 1.0 - sum(busy) / (S * makespan),
         "peak_live_buffers": [sim.peak_live for sim in sims],
+        "peak_live_stash": [sim.peak_stash for sim in sims],
+        "stash": stashed,
         "declared_buffers": list(compiled.num_buffers),
+        "declared_stash_slots": list(getattr(compiled, "num_stash_slots",
+                                             [0] * len(compiled.num_buffers))),
         "total_instructions": sum(len(st) for st in streams),
         "p2p_transfers": p2p_transfers,
     }
 
 
 def bubble_report(schedule, micro_batches, stages, virtual_stages=1,
-                  costs: Optional[CostModel] = None) -> dict:
+                  costs: Optional[CostModel] = None, stash=False) -> dict:
     """Compile + simulate in one call (the tools/tests entry point)."""
     compiled = sched_lib.compile_schedule(
-        schedule, micro_batches, stages, virtual_stages)
+        schedule, micro_batches, stages, virtual_stages, stash=stash)
     return simulate(compiled, costs)
 
 

@@ -92,6 +92,11 @@ class PipelineEngine(DeepSpeedEngine):
         self._compiled_schedule = None    # CompiledSchedule, lazy
         self._last_p2p_bytes = 0          # measured p2p volume, last batch
         self._p2p_edge_bytes = {}         # global chunk -> (act, grad) bytes
+        # zb-h1 activation stashing (resolved lazily by _arm_stash once
+        # shapes are known: the budget check needs per-micro stash bytes)
+        self._stash_armed = False
+        self._stash_blockers = []
+        self._stash_bytes_per_chunk = None  # per-micro vjp-residual bytes
 
         if self.progressive_layer_drop is not None:
             # base engine injects pld_theta into flat batches; the pipeline
@@ -328,6 +333,7 @@ class PipelineEngine(DeepSpeedEngine):
                 accum=accum))
             self._stage_shardings.append((rep, zero, opt_sh))
         self._build_stage_jits()
+        self._arm_stash(sample_micro)
         n = sum(self.module.num_params(st.params) for st in self.stage_states)
         log_dist(f"Pipeline state initialized: {n/1e6:.1f}M params over "
                  f"{self.num_stages} stages x {self.virtual_stages} chunks "
@@ -526,6 +532,55 @@ class PipelineEngine(DeepSpeedEngine):
                 (gp,) = vjp((gy, (scale / gas).astype(jnp.float32)))
                 return accum_add(accum, gp)
 
+            # --- zb-h1 + activation stashing ------------------------------
+            # The forward runs ONCE per (chunk, micro) and returns its vjp
+            # closure — a jax.tree_util.Partial whose array leaves are the
+            # saved residuals (every checkpoint_name'd intermediate the
+            # model's remat_policy would have kept, and then some): that
+            # Partial IS the stash, crossing the jit boundary as a pytree.
+            # dgrad evaluates the cotangent chain only (XLA DCEs the
+            # param-transpose work), wgrad replays the chain into the
+            # param grads — neither pass recomputes the forward, which is
+            # exactly CostModel.stash()'s d = w = 1.  wgrad DONATES the
+            # stash (and accum): the residual buffers free in place on the
+            # dgrad->wgrad handoff instead of surviving to the end of the
+            # batch.  rng/dropout consistency is free — there is only one
+            # forward, so dgrad and wgrad share its masks by construction.
+            def fwd_stash_mid(params, x, rng, fwd_aux=fwd_aux):
+                def f(p, x_):
+                    y, aux = fwd_aux(p, x_, rng)
+                    return y, jnp.asarray(aux, jnp.float32)
+
+                (y, aux), stash = jax.vjp(f, params, x)
+                return y, aux, stash
+
+            def fwd_stash_last(params, x, rng, batch, scale,
+                               fwd_loss=fwd_loss):
+                def scaled(p, x_):
+                    loss, aux = fwd_loss(p, x_, rng, batch)
+                    with_aux = loss.astype(jnp.float32) + aux
+                    return with_aux * scale / gas, with_aux
+
+                _, stash, loss = jax.vjp(scaled, params, x, has_aux=True)
+                return loss, stash
+
+            def bwd_dgrad_last_stash(stash):
+                _, gx = stash(jnp.float32(1.0))
+                return gx
+
+            def bwd_dgrad_mid_stash(stash, gy, scale):
+                _, gx = stash((gy, (scale / gas).astype(jnp.float32)))
+                return gx
+
+            def bwd_wgrad_last_stash(stash, accum, accum_add=accum_add):
+                gp, _ = stash(jnp.float32(1.0))
+                return accum_add(accum, gp)
+
+            def bwd_wgrad_mid_stash(stash, accum, gy, scale,
+                                    accum_add=accum_add):
+                gp, _ = stash((gy, (scale / gas).astype(jnp.float32)))
+                return accum_add(accum, gp)
+
             submesh = self._chunk_mesh(s)
             jits = {
                 "fwd": jax.jit(fwd),
@@ -545,7 +600,125 @@ class PipelineEngine(DeepSpeedEngine):
                 jits["bwd_wgrad"] = (
                     jax.jit(bwd_last_wgrad, donate_argnums=(1,)) if is_last
                     else jax.jit(bwd_mid_wgrad, donate_argnums=(1,)))
+                # stash twins (compiled only if _arm_stash arms: jax.jit
+                # wrappers are lazy).  dgrad must NOT donate the stash —
+                # the deferred wgrad is its second consumer.
+                jits["fwd_stash"] = jax.jit(
+                    fwd_stash_last if is_last else fwd_stash_mid)
+                jits["bwd_dgrad_stash"] = jax.jit(
+                    bwd_dgrad_last_stash if is_last else bwd_dgrad_mid_stash)
+                jits["bwd_wgrad_stash"] = jax.jit(
+                    bwd_wgrad_last_stash if is_last else bwd_wgrad_mid_stash,
+                    donate_argnums=(0, 1))
             self._stage_jits.append(jits)
+
+    def _stash_bytes_estimate(self, sample_micro):
+        """Per-chunk, per-micro stash bytes (the vjp-residual leaves of one
+        fwd_stash call), by abstract evaluation — no device work.  Chains
+        the chunk output shapes forward exactly as the executor does."""
+        import jax
+
+        C = self.num_chunks
+        rng = jax.random.PRNGKey(0)
+        scale = np.float32(1.0)
+        x = self.module.input_fn(sample_micro)
+        out = []
+        for q in range(C):
+            jits = self._stage_jits[q]
+            with jax.set_mesh(self._chunk_mesh(q)):
+                if q < C - 1:
+                    x, _aux, stash = jax.eval_shape(
+                        jits["fwd_stash"], self.stage_states[q].params,
+                        x, rng)
+                else:
+                    _loss, stash = jax.eval_shape(
+                        jits["fwd_stash"], self.stage_states[q].params,
+                        x, rng, sample_micro, scale)
+            out.append(sum(
+                int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
+                for l in jax.tree_util.tree_leaves(stash)))
+        return out
+
+    def _arm_stash(self, sample_micro):
+        """Resolve zb-h1 activation stashing against its blockers.
+
+        Sets self._stash_armed / self._stash_blockers /
+        self._stash_bytes_per_chunk.  Armed, the executor runs the forward
+        once per (chunk, micro) and the split backward consumes the stash;
+        any blocker falls back to the remat split backward with DISARMED
+        warnings naming it — including one warning PER STAGE whose
+        analytic peak stash bytes exceed ``pipeline.stash_budget``."""
+        from deepspeed_tpu.runtime.constants import (PIPELINE_STASH,
+                                                     PIPELINE_STASH_BUDGET)
+        from deepspeed_tpu.runtime.pipe import bubble_accounting as ba
+
+        pcfg = self._config.pipeline
+        requested = pcfg[PIPELINE_STASH]
+        budget = int(pcfg[PIPELINE_STASH_BUDGET])
+        self._stash_armed = False
+        self._stash_blockers = []
+        zb = self.pipe_schedule == sched_lib.SCHEDULE_ZB_H1
+        if requested is False:
+            return
+        if not zb:
+            if requested is True:
+                # explicit request on a non-zb schedule warns; "auto" is
+                # silently inert (stashing is a zb-h1 refinement)
+                self._stash_blockers = [
+                    f"effective schedule is '{self.pipe_schedule}' "
+                    f"(stashing feeds the zb-h1 split backward; fused "
+                    f"backwards already recompute exactly once)"]
+                log_dist(
+                    f"PipelineEngine: activation_stashing DISARMED — "
+                    f"{self._stash_blockers[0]}",
+                    ranks=[0], level=logging.WARNING)
+            return
+        blockers = []
+        try:
+            per_chunk = self._stash_bytes_estimate(sample_micro)
+        except Exception as e:  # lint: allow-broad-except — stashing is an
+            # optimization: any abstract-eval failure must DISARM it (and
+            # name itself), never take down training
+            per_chunk = None
+            blockers.append(f"stash-size estimation failed "
+                            f"({type(e).__name__}: {e})")
+        self._stash_bytes_per_chunk = per_chunk
+        if per_chunk is not None and budget > 0:
+            rep = ba.simulate(sched_lib.compile_schedule(
+                sched_lib.SCHEDULE_ZB_H1, self.micro_batches,
+                self.num_stages, stash=True))
+            for s, peak in enumerate(rep["peak_live_stash"]):
+                need = peak * per_chunk[s]
+                if need > budget:
+                    why = (f"stage {s} needs {need} stash bytes at peak "
+                           f"({peak} live micros x {per_chunk[s]} B) > "
+                           f"pipeline.stash_budget={budget}")
+                    blockers.append(why)
+                    log_dist(
+                        f"PipelineEngine: activation_stashing DISARMED on "
+                        f"stage {s} — {why}; falling back to remat",
+                        ranks=[0], level=logging.WARNING)
+        self._stash_blockers = blockers
+        self._stash_armed = not blockers
+        if self._stash_armed:
+            import warnings
+
+            # bwd_wgrad_stash's donated residuals that alias no output
+            # draw XLA's 'donated buffers were not usable' warning at
+            # lowering; that is the expected rendering of the stash
+            # contract (buffer donors), not a lost alias.  Filter ONCE
+            # here instead of paying a catch_warnings save/restore per
+            # instruction in the dispatch hot loop.
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+        if blockers and not any("stash_budget" in b for b in blockers):
+            log_dist(
+                f"PipelineEngine: activation_stashing DISARMED — "
+                f"{'; '.join(blockers)}; falling back to remat",
+                ranks=[0], level=logging.WARNING)
+        # the compiled stream depends on the stash decision (wgrad slots
+        # are timed at d = w = 1 and stash slots are emitted)
+        self._compiled_schedule = None
 
     # ------------------------------------------------------------------
     # batch placement
@@ -717,7 +890,7 @@ class PipelineEngine(DeepSpeedEngine):
         if self._compiled_schedule is None:
             self._compiled_schedule = sched_lib.compile_schedule(
                 self.pipe_schedule, self.micro_batches, self.num_stages,
-                self.virtual_stages)
+                self.virtual_stages, stash=self._stash_armed)
         return self._compiled_schedule
 
     def _exec_train_schedule(self, micros):
@@ -742,6 +915,12 @@ class PipelineEngine(DeepSpeedEngine):
         in_grad = [[None] * nbuf[q] for q in range(C)]   # recv'd dL/dout
         out_grad = [[None] * nbuf[q] for q in range(C)]  # computed dL/din
         micro_dev = [[None] * nbuf[q] for q in range(C)] # loaded micro
+        # the COMPILED stream is the single source of truth: stash mode
+        # only runs against a stream that emitted stash slots
+        stashed = compiled.stash
+        # stash slots (zb-h1 stashing): the forward's vjp residuals, live
+        # from ForwardPass until BackwardWeightPass donates them away
+        stash_buf = [[None] * n for n in compiled.num_stash_slots]
         act_q = [deque() for _ in range(C)]   # inbound acts per dest chunk
         grad_q = [deque() for _ in range(C)]  # inbound grads per dest chunk
         losses = []
@@ -786,10 +965,27 @@ class PipelineEngine(DeepSpeedEngine):
                 in_grad[q][buf] = grad_q[q].popleft()
             elif isinstance(cmd, sched_lib.ForwardPass):
                 with jax.set_mesh(self._chunk_mesh(q)):
-                    if q < C - 1:
+                    if stashed:
+                        # forward runs ONCE: its vjp residuals are the
+                        # stash; the saved input (and last-chunk labels)
+                        # free here — the residuals supersede them
+                        if q == C - 1:
+                            loss, stash_buf[q][buf] = jits["fwd_stash"](
+                                st.params, in_act[q][buf], micro_rngs[mb],
+                                micro_dev[q][buf], scale)
+                            losses.append(loss)
+                            micro_dev[q][buf] = None
+                        else:
+                            out_act[q][buf], aux, stash_buf[q][buf] = \
+                                jits["fwd_stash"](st.params, in_act[q][buf],
+                                                  micro_rngs[mb])
+                            if self._module_has_aux:
+                                mid_auxes[q].append(aux)
+                        in_act[q][buf] = None
+                    elif q < C - 1:
                         out_act[q][buf] = jits["fwd"](
                             st.params, in_act[q][buf], micro_rngs[mb])
-                    # last chunk: loss computed in the backward (fused)
+                    # last chunk w/o stash: loss computed in the backward
             elif isinstance(cmd, sched_lib.BackwardPass):
                 with jax.set_mesh(self._chunk_mesh(q)):
                     if q == C - 1:
@@ -809,10 +1005,18 @@ class PipelineEngine(DeepSpeedEngine):
                 in_act[q][buf] = None
                 in_grad[q][buf] = None
             elif isinstance(cmd, sched_lib.BackwardGradPass):
-                # zb dgrad: unblocks the upstream stage; keeps in_act and
-                # in_grad LIVE for the deferred wgrad
+                # zb dgrad: unblocks the upstream stage.  Stashed: consume
+                # the forward's residuals (no recompute), keeping the stash
+                # and in_grad LIVE for the deferred wgrad.  Remat: keeps
+                # in_act and in_grad live and re-runs the forward.
                 with jax.set_mesh(self._chunk_mesh(q)):
-                    if q == C - 1:
+                    if stashed:
+                        if q == C - 1:
+                            gx = jits["bwd_dgrad_stash"](stash_buf[q][buf])
+                        else:
+                            gx = jits["bwd_dgrad_stash"](
+                                stash_buf[q][buf], in_grad[q][buf], scale)
+                    elif q == C - 1:
                         gx, loss = jits["bwd_dgrad"](
                             st.params, in_act[q][buf], micro_rngs[mb],
                             micro_dev[q][buf], scale)
@@ -826,7 +1030,20 @@ class PipelineEngine(DeepSpeedEngine):
                     out_grad[q][buf] = gx
             elif isinstance(cmd, sched_lib.BackwardWeightPass):
                 with jax.set_mesh(self._chunk_mesh(q)):
-                    if q == C - 1:
+                    if stashed:
+                        # the wgrad jit DONATES the stash (+ accum): the
+                        # residual buffers free in place here (XLA's
+                        # unusable-donation warning for donor-only leaves
+                        # is filtered once at _arm_stash time)
+                        if q == C - 1:
+                            new_accum = jits["bwd_wgrad_stash"](
+                                stash_buf[q][buf], st.accum)
+                        else:
+                            new_accum = jits["bwd_wgrad_stash"](
+                                stash_buf[q][buf], st.accum,
+                                in_grad[q][buf], scale)
+                        stash_buf[q][buf] = None
+                    elif q == C - 1:
                         new_accum = jits["bwd_wgrad"](
                             st.params, st.accum, in_act[q][buf],
                             micro_rngs[mb], micro_dev[q][buf], scale)
@@ -911,10 +1128,31 @@ class PipelineEngine(DeepSpeedEngine):
         from deepspeed_tpu.runtime import comm_accounting as ca
         from deepspeed_tpu.runtime.pipe import bubble_accounting as ba
 
+        from deepspeed_tpu.runtime.constants import (PIPELINE_STASH,
+                                                     PIPELINE_STASH_BUDGET)
+
         compiled = self._ensure_compiled_schedule()
         report = ba.simulate(compiled, costs)
         report["requested_schedule"] = self.requested_schedule
         report["schedule_blockers"] = list(self._schedule_blockers)
+        budget = int(self._config.pipeline[PIPELINE_STASH_BUDGET])
+        stash_info = {
+            "requested": self._config.pipeline[PIPELINE_STASH],
+            "armed": self._stash_armed,
+            "blockers": list(self._stash_blockers),
+            "budget_bytes": budget or None,
+            # arming needs shapes: before the first batch the decision is
+            # still open and the report says so instead of guessing
+            "resolved": self.stage_states is not None,
+        }
+        if self._stash_bytes_per_chunk is not None:
+            stash_info["bytes_per_micro_per_chunk"] = \
+                list(self._stash_bytes_per_chunk)
+            if self._stash_armed:
+                stash_info["peak_bytes_per_stage"] = [
+                    peak * self._stash_bytes_per_chunk[s]
+                    for s, peak in enumerate(report["peak_live_stash"])]
+        report["stash"] = stash_info
         if self.pipe_schedule != sched_lib.SCHEDULE_1F1B:
             base = ba.bubble_report(
                 sched_lib.SCHEDULE_1F1B, self.micro_batches,
@@ -986,27 +1224,59 @@ class PipelineEngine(DeepSpeedEngine):
         assert self.stage_states is not None, \
             "run one batch (or _ensure_pipe_state) before load_checkpoint"
 
-    def _write_checkpoint_files(self, path, client_state, backend):
-        """Pipeline payload: layer-granular layout — one file per layer
-        param key, entries keyed by the leaf's tree path (identical no
-        matter which stage owns the layer), plus a 'globals' file for
-        layer-independent optimizer scalars (identical on every stage).
-        Runs inside the parent's atomic commit path: ``path`` is the tag
-        temp dir and each write feeds the chaos fault-injection hooks."""
+    def _resolve_ckpt_backend(self, backend):
         if backend not in (None, "auto", "npz", "npz-layer"):
             raise ValueError(
                 f"pipeline checkpoints only support the layer-granular npz "
                 f"backend; got backend={backend!r}")
+        return "npz-layer"
+
+    def _ckpt_host_snapshot(self, client_state, backend, copy_host=False):
+        """Device->host transfer of every stage's persisted slice, plus
+        the metadata — the foreground part of a commit; the writer below
+        is pure filesystem work over this snapshot.  ``copy_host`` is
+        moot here: device_get already yields host arrays owned by the
+        snapshot (nothing mutates them in place)."""
+        import jax
+
+        host_states = [jax.device_get(self._stage_save_tree(st))
+                       for st in self.stage_states]
+        meta = {
+            "global_steps": self.global_steps,
+            "micro_steps": self.micro_steps,
+            "skipped_steps": self._host_skipped,
+            "cur_scale": self._pipe_scaler.cur_scale,
+            "scaler_state": self._pipe_scaler.__dict__.copy(),
+            "num_stages": self.num_stages,
+            "virtual_stages": self.virtual_stages,
+            "schedule": self.pipe_schedule,
+            "partition": self.module.partition_layers(self.num_chunks),
+            "layer_keys": sorted(self._layer_key_set()),
+            "format": "layer-granular",
+            "lr_scheduler": self.lr_scheduler.state_dict()
+            if self.lr_scheduler is not None else None,
+            "client_state": client_state,
+        }
+        return {"host_states": host_states, "meta": meta,
+                "backend": "npz-layer"}
+
+    def _write_snapshot_files(self, path, snap):
+        """Pipeline payload: layer-granular layout — one file per layer
+        param key, entries keyed by the leaf's tree path (identical no
+        matter which stage owns the layer), plus a 'globals' file for
+        layer-independent optimizer scalars (identical on every stage).
+        Runs inside the atomic commit path (sync, or on the async commit
+        thread): ``path`` is the tag temp dir and each write feeds the
+        chaos fault-injection hooks."""
         import jax
 
         from deepspeed_tpu.runtime.checkpoint_utils import named_leaf_entry
         from deepspeed_tpu.runtime.resilience import chaos
 
-        layer_keys = self._layer_key_set()
+        layer_keys = set(snap["meta"]["layer_keys"])
         per_layer = {}
         global_leaves = {}
-        for st in self.stage_states:
-            host = jax.device_get(self._stage_save_tree(st))
+        for host in snap["host_states"]:
             for p, leaf in jax.tree_util.tree_flatten_with_path(host)[0]:
                 entry = named_leaf_entry(jax.tree_util.keystr(p), leaf)
                 k = self._path_layer_key(p, layer_keys)
@@ -1021,29 +1291,18 @@ class PipelineEngine(DeepSpeedEngine):
         fname = os.path.join(path, "globals-states.npz")
         self._ckpt_savez(fname, **global_leaves)
         chaos.file_written(fname)
-        meta = {
-            "global_steps": self.global_steps,
-            "micro_steps": self.micro_steps,
-            "skipped_steps": self._host_skipped,
-            "cur_scale": self._pipe_scaler.cur_scale,
-            "scaler_state": self._pipe_scaler.__dict__.copy(),
-            "num_stages": self.num_stages,
-            "virtual_stages": self.virtual_stages,
-            "schedule": self.pipe_schedule,
-            "partition": self.module.partition_layers(self.num_chunks),
-            "layer_keys": sorted(layer_keys),
-            "format": "layer-granular",
-            "lr_scheduler": self.lr_scheduler.state_dict()
-            if self.lr_scheduler is not None else None,
-            "client_state": client_state,
-        }
         fname = os.path.join(path, "metadata.pkl")
         with open(fname, "wb") as f:
-            pickle.dump(meta, f)
+            pickle.dump(snap["meta"], f)
         chaos.file_written(fname)
         log_dist(f"Wrote pipeline checkpoint payload "
                  f"({len(per_layer)} layer files)", ranks=[0])
-        return "npz-layer"
+
+    def _write_checkpoint_files(self, path, client_state, backend):
+        backend = self._resolve_ckpt_backend(backend)
+        self._write_snapshot_files(
+            path, self._ckpt_host_snapshot(client_state, backend))
+        return backend
 
     def _ckpt_state_snapshot(self):
         snap = super()._ckpt_state_snapshot()

@@ -317,10 +317,21 @@ class CompiledSchedule:
     streams[s] is executed in order by stage s; cross-stage data moves
     through per-(global chunk, kind) FIFO queues, so the only ordering
     contract is send-before-matching-recv (the engine blocks, the
-    bubble simulator proves deadlock freedom)."""
+    bubble simulator proves deadlock freedom).
+
+    ``stash=True`` marks a zero-bubble schedule compiled for activation
+    STASHING: each ForwardPass additionally fills a stash slot (the vjp
+    residuals of the single forward) that stays live until the micro's
+    BackwardWeightPass frees it — dgrad and wgrad consume the stash
+    instead of recomputing the forward.  Stash slots reuse the stream's
+    explicit buffer_ids (the F->W lifetime IS the buffer lifetime in a
+    zb stream), so ``num_stash_slots`` per chunk equals ``num_buffers``
+    there and is 0 for schedules compiled without stashing — executors
+    and tools must refuse to run stash-mode cost models against a
+    schedule whose slots were never emitted."""
 
     def __init__(self, name, micro_batches, stages, virtual_stages,
-                 streams, num_buffers):
+                 streams, num_buffers, stash=False):
         self.name = name
         self.micro_batches = micro_batches
         self.stages = stages
@@ -328,13 +339,17 @@ class CompiledSchedule:
         self.num_chunks = stages * virtual_stages
         self.streams = streams            # list[stages] of instruction lists
         self.num_buffers = num_buffers    # list[num_chunks] buffer slots
+        self.stash = stash
+        self.num_stash_slots = list(num_buffers) if stash \
+            else [0] * len(num_buffers)
 
     def global_chunk(self, stage_id, chunk_id):
         return chunk_id * self.stages + stage_id
 
     def __repr__(self):
         return (f"CompiledSchedule({self.name}, micro={self.micro_batches}, "
-                f"stages={self.stages}, v={self.virtual_stages})")
+                f"stages={self.stages}, v={self.virtual_stages}"
+                f"{', stash' if self.stash else ''})")
 
 
 def _order_1f1b(micro_batches, stages, stage_id, bwd_op="B"):
@@ -528,7 +543,8 @@ def _emit_streams(orders, stages):
     return streams, [a.high_water for a in slots]
 
 
-def compile_schedule(name, micro_batches, stages, virtual_stages=1):
+def compile_schedule(name, micro_batches, stages, virtual_stages=1,
+                     stash=False):
     """Build the CompiledSchedule for a training batch.
 
     1f1b        — the classic schedule (identical math/op order to
@@ -538,12 +554,20 @@ def compile_schedule(name, micro_batches, stages, virtual_stages=1):
                   the pipeline bubble by ~1/v at the cost of (v-1) extra
                   p2p boundary crossings per micro;
     zb-h1       — zero-bubble H1: backwards split into dgrad/wgrad, wgrads
-                  deferred into bubble slots.
+                  deferred into bubble slots.  ``stash=True`` compiles the
+                  activation-STASHING variant: the greedy wgrad placement
+                  is timed at dgrad = wgrad = 1 (neither split pass pays a
+                  forward recompute — both consume the forward's stashed
+                  vjp residuals) and every buffer slot doubles as a stash
+                  slot (CompiledSchedule.num_stash_slots).
 
     Callers gate/fall back (with DISARMED warnings) BEFORE calling; this
     function asserts hard on violated preconditions.
     """
     M, S, v = micro_batches, stages, virtual_stages
+    assert not stash or name == SCHEDULE_ZB_H1, \
+        "activation stashing composes with the zb-h1 schedule only (the " \
+        "fused backward of 1f1b/interleaved already recomputes exactly once)"
     if name == SCHEDULE_1F1B:
         assert v == 1, "1f1b has no virtual stages"
         orders = [_order_1f1b(M, S, s) for s in range(S)]
@@ -553,14 +577,18 @@ def compile_schedule(name, micro_batches, stages, virtual_stages=1):
     elif name == SCHEDULE_ZB_H1:
         assert v == 1, "zb-h1 composes with v=1 only"
         assert S >= 2
-        orders = _plan_zb_h1(M, S)
+        if stash:
+            orders = _plan_zb_h1(M, S, fwd_cost=1.0, dgrad_cost=1.0,
+                                 wgrad_cost=1.0)
+        else:
+            orders = _plan_zb_h1(M, S)
     else:
         raise KeyError(f"unknown pipeline schedule {name!r}; "
                        f"known: {KNOWN_SCHEDULES}")
     streams, num_buffers = _emit_streams(orders, S)
     while len(num_buffers) < S * v:       # chunks that never got a slot
         num_buffers.append(1)
-    return CompiledSchedule(name, M, S, v, streams, num_buffers)
+    return CompiledSchedule(name, M, S, v, streams, num_buffers, stash=stash)
 
 
 class DataParallelSchedule(PipeSchedule):

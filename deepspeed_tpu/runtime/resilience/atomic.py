@@ -430,13 +430,31 @@ class atomic_tag:
         return False
 
     def _commit(self):
+        self._seal()
+        self._publish()
+
+    def _seal(self, progress_cb=None):
+        """Durability phase: manifest + fsync of every payload file and the
+        temp dir itself.  This is the payload-size-dependent part of the
+        commit — the only part an async commit moves off the training
+        thread.  ``progress_cb`` (if given) is called after each fsync'd
+        file so a slow disk keeps signaling liveness."""
         self.meta.setdefault("tag", self.tag)
         chaos.point("before_manifest")
         write_manifest(self.tmp, self.meta, fsync=self.fsync)
+        if progress_cb is not None:
+            progress_cb()
         if self.fsync:
             for rel in _walk_payload(self.tmp):
                 _fsync_path(os.path.join(self.tmp, rel))
+                if progress_cb is not None:
+                    progress_cb()
             _fsync_path(self.tmp)
+
+    def _publish(self):
+        """Visibility phase: the atomic rename (+ latest-pointer-last).
+        O(1) in payload size — the only piece of an async commit that runs
+        on the training thread."""
         chaos.point("before_rename")
         if os.path.isdir(self.final):
             # tag overwrite needs two renames (os.replace can't swap
@@ -462,6 +480,118 @@ class atomic_tag:
         chaos.point("before_latest")
         if self.update_latest:
             write_latest(self.save_dir, self.tag, fsync=self.fsync)
+
+
+class PendingCommit:
+    """One in-flight ASYNC checkpoint commit.
+
+    Split of responsibilities (the async analog of ``atomic_tag``):
+
+    - background thread (``start``): temp-dir setup, ``write_fn(tmp)``
+      (the engine's payload writer over an already-host-resident
+      snapshot), manifest + streaming-hash bookkeeping, fsync of every
+      file — ALL the payload-size-dependent work;
+    - foreground (``finalize``, called from the training thread once
+      ``ready()``): the atomic rename + latest-pointer-last, O(1) in
+      payload size.
+
+    Crash-safety is inherited from the atomic layout: until ``finalize``
+    runs, only a ``.tmp-`` dir exists (ignored by loads, GC'd later), so
+    a kill at ANY point — mid-write, mid-fsync, before or during the
+    rename — never yields a torn tag or a ``latest`` pointer at
+    unverified bytes.  A background failure (including an armed chaos
+    kill) is re-raised by ``finalize``/``wait`` on the calling thread
+    after removing the temp dir.
+
+    ``heartbeat`` (optional callable) is invoked by the background thread
+    after each written/fsync'd file so a slow disk keeps feeding the
+    TrainingWatchdog instead of being misdiagnosed as a training stall.
+    """
+
+    def __init__(self, commit, write_fn, heartbeat=None):
+        assert isinstance(commit, atomic_tag)
+        self.commit = commit
+        self.write_fn = write_fn
+        self.heartbeat = heartbeat
+        self.error = None
+        self.finalized = False
+        self._done = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"ckpt-commit-{commit.tag}", daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _beat(self):
+        if self.heartbeat is not None:
+            self.heartbeat()
+
+    def _run(self):
+        try:
+            self._beat()
+            self.commit.__enter__()
+            self.write_fn(self.commit.tmp)
+            self._beat()
+            self.commit._seal(progress_cb=self._beat)
+            self._beat()
+        except BaseException as e:  # noqa: B036 - surfaced via finalize()
+            self.error = e
+            shutil.rmtree(self.commit.tmp, ignore_errors=True)
+        finally:
+            self._done.set()
+
+    def ready(self):
+        """True once the background durability work has finished (well or
+        badly) — i.e. ``finalize`` will not block."""
+        return self._done.is_set()
+
+    def wait(self, timeout=None):
+        """Block until the background work finishes; True if it did."""
+        return self._done.wait(timeout)
+
+    def finalize(self):
+        """Publish the sealed tag: atomic rename + latest-pointer-last.
+
+        Runs on the CALLING (training) thread; blocks until the
+        background seal completes if it has not already.  Re-raises any
+        background error (the temp dir is already cleaned up), and cleans
+        up + re-raises on a publish-side failure, so save_dir is either
+        'previous checkpoint intact' or 'new tag fully committed'."""
+        self._done.wait()
+        if self.finalized:
+            return
+        if self.error is not None:
+            raise self.error  # repeat finalize calls keep raising
+        try:
+            self.commit._publish()
+        except BaseException:
+            shutil.rmtree(self.commit.tmp, ignore_errors=True)
+            raise
+        finally:
+            self.finalized = True
+
+
+class FollowerCommit:
+    """Placeholder pending commit for non-leader ranks of a multi-host
+    async save: npz-family backends write payload on process 0 only, and
+    only process 0 publishes — followers hold this so every rank runs
+    the same finalize choreography (the all_agree phases) in lockstep."""
+
+    error = None
+    finalized = False
+
+    def start(self):
+        return self
+
+    def ready(self):
+        return True
+
+    def wait(self, timeout=None):
+        return True
+
+    def finalize(self):
+        self.finalized = True
 
 
 def write_latest(save_dir, tag, fsync=True):

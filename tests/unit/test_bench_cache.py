@@ -1,0 +1,79 @@
+"""bench.py resumability units: the phase cache that lets a round killed
+by the container budget leave evidence for the next one (ISSUE 6
+satellite — BENCH_r02/r04/r05 all died at phase=importing_jax with
+nothing persisted, so the MFU trajectory was unobservable)."""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cfg_hash_stable_and_spec_sensitive():
+    b = _bench()
+    base = argparse.Namespace(
+        model="gpt2-350m", batch=48, seq=1024, steps=20, warmup=3,
+        scan_layers=1, remat=1, remat_policy="nothing", allow_cpu=0,
+        loss_chunk=8192, offload=0, onebit=0, sparse=0)
+    h1 = b._cfg_hash({"model": "gpt2-125m", "batch": 8}, base)
+    h2 = b._cfg_hash({"model": "gpt2-125m", "batch": 8}, base)
+    h3 = b._cfg_hash({"model": "gpt2-125m", "batch": 16}, base)
+    assert h1 == h2
+    assert h1 != h3
+    # keys outside the spec identity (timeouts etc.) don't change the hash
+    assert b._cfg_hash({"model": "gpt2-125m", "batch": 8,
+                        "timeout": 999}, base) == h1
+
+
+def test_cache_roundtrip_and_corruption_tolerance(tmp_path):
+    b = _bench()
+    path = str(tmp_path / "cache.json")
+    assert b._load_cache(path) == {}          # missing file
+    b._save_cache(path, {"abc": {"ok": True, "updated": 1}})
+    assert b._load_cache(path)["abc"]["ok"] is True
+    # atomic rewrite leaves no temp droppings
+    assert os.listdir(tmp_path) == ["cache.json"]
+    with open(path, "w") as f:
+        f.write("{ torn json")                # budget kill mid-...
+    assert b._load_cache(path) == {}          # tolerated, not raised
+    with open(path, "w") as f:
+        json.dump(["not", "a", "dict"], f)
+    assert b._load_cache(path) == {}
+
+
+def test_worker_serve_flag_wired():
+    """--worker-serve and --phase-cache exist and route (smoke: the
+    parser accepts them; the serve loop itself is exercised end-to-end
+    by the bench driver, not under tier-1's budget)."""
+    b = _bench()
+    argv = sys.argv
+    try:
+        sys.argv = ["bench.py", "--worker-serve", "--allow_cpu", "1",
+                    "--phase-cache", "/tmp/x.json"]
+        # parse only: calling main would import jax and serve stdin
+        p_args = None
+        real_serve = b.run_worker_serve
+
+        def capture(a):
+            nonlocal p_args
+            p_args = a
+            return 0
+
+        b.run_worker_serve = capture
+        assert b.main() == 0
+        assert p_args.worker_serve and p_args.allow_cpu == 1
+        assert p_args.phase_cache == "/tmp/x.json"
+        b.run_worker_serve = real_serve
+    finally:
+        sys.argv = argv

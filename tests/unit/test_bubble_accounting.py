@@ -30,9 +30,12 @@ def test_round5_bench_notes_numbers():
 
 
 def test_schedule_quality_guard():
-    """Tier-1 guard (ISSUE 3 acceptance): at pipe=4, gas=8 the analytic
-    bubble fraction must order interleaved(v=2) < 1f1b and
-    zb-h1 <= interleaved(v=2), under the default cost model."""
+    """Tier-1 guard (ISSUE 3 + ISSUE 6 acceptance): at pipe=4, gas=8 the
+    analytic bubble fraction must order interleaved(v=2) < 1f1b and
+    zb-h1 <= interleaved(v=2) — and with activation stashing (ISSUE 6),
+    zb-h1 must be a genuine THROUGHPUT win: makespan 27 < 1f1b's 33
+    under CostModel(dgrad=1, wgrad=1), replayed by the simulator, with
+    the worst-stage activation peak still within 1F1B's bound."""
     base = ba.bubble_report("1f1b", 8, 4)["bubble_fraction"]
     inter = ba.bubble_report("interleaved", 8, 4,
                              virtual_stages=2)["bubble_fraction"]
@@ -43,6 +46,25 @@ def test_schedule_quality_guard():
     assert base == pytest.approx(0.2727, abs=2e-3)
     assert inter <= 0.16
     assert zb <= 0.13
+    # --- the stashing flip: zb-h1 WINS makespan, not just bubble -------
+    stash_costs = ba.CostModel(fwd=1, bwd=2, dgrad=1, wgrad=1)
+    zb_stash = ba.bubble_report("zb-h1", 8, 4, stash=True,
+                                costs=stash_costs)
+    base_stash = ba.bubble_report("1f1b", 8, 4, costs=stash_costs)
+    assert zb_stash["makespan"] < base_stash["makespan"], \
+        (f"zb-h1+stash makespan {zb_stash['makespan']} !< 1f1b "
+         f"{base_stash['makespan']}")
+    assert zb_stash["makespan"] == pytest.approx(27.0)
+    assert base_stash["makespan"] == pytest.approx(33.0)
+    # memory bound: stashing must not grow the worst-stage peak beyond
+    # 1F1B's (the documented min(S, M) in-flight cap), and the stash
+    # lifetime (F -> W) peaks at the same count
+    assert max(zb_stash["peak_live_buffers"]) <= \
+        max(base_stash["peak_live_buffers"])
+    assert max(zb_stash["peak_live_stash"]) <= 4  # min(S, M) at 4/8
+    # stash=True is also the simulator default for stash-compiled streams
+    assert ba.bubble_report("zb-h1", 8, 4, stash=True)["makespan"] == \
+        pytest.approx(27.0)
 
 
 @pytest.mark.parametrize("schedule,v", [("1f1b", 1), ("interleaved", 2),
@@ -114,15 +136,45 @@ def test_cost_model_scales_with_virtual_stages():
 
 
 def test_zb_remat_tax_shows_in_makespan():
-    """Under always-remat (the default model) zb-h1's HIGH utilization
-    must not read as a throughput win: its makespan exceeds 1f1b's at the
-    guard point. With activation stashing (d=1, w=1 — the ZB paper's
-    assumption) the same schedule IS a genuine makespan win; both facts
-    are the documented trade in docs/tutorials/pipeline_schedules.md."""
+    """A zb-h1 stream compiled WITHOUT stash slots still pays the remat
+    tax, and the report must not hide it: under the remat-honest default
+    model its makespan exceeds 1f1b's at the guard point.  The same
+    schedule compiled with stash slots defaults to CostModel.stash() and
+    IS a genuine makespan win; both facts are the documented trade in
+    docs/tutorials/pipeline_schedules.md."""
     base = ba.bubble_report("1f1b", 8, 4)
     zb = ba.bubble_report("zb-h1", 8, 4)
     assert zb["makespan"] > base["makespan"]
-    stash = ba.CostModel(fwd=1, bwd=2, dgrad=1.0, wgrad=1.0)
-    zb_stash = ba.bubble_report("zb-h1", 8, 4, costs=stash)
-    base_stash = ba.bubble_report("1f1b", 8, 4, costs=stash)
-    assert zb_stash["makespan"] < base_stash["makespan"]
+    assert zb["stash"] is False and zb["peak_live_stash"] == [0] * 4
+    zb_stash = ba.bubble_report("zb-h1", 8, 4, stash=True)
+    assert zb_stash["stash"] is True
+    assert zb_stash["cost_model"]["dgrad"] == 1.0   # stash default model
+    assert zb_stash["makespan"] < base["makespan"]
+
+
+def test_stash_slots_only_on_stash_compile():
+    """Stash slots are an explicit compile artifact: a remat stream
+    declares none (executors/tools must refuse stash-mode accounting on
+    it), a stash stream declares one per buffer slot."""
+    import deepspeed_tpu.runtime.pipe.schedule as sched_lib
+
+    remat = sched_lib.compile_schedule("zb-h1", 8, 4)
+    stash = sched_lib.compile_schedule("zb-h1", 8, 4, stash=True)
+    assert remat.num_stash_slots == [0] * 4
+    assert stash.num_stash_slots == stash.num_buffers
+    assert all(n > 0 for n in stash.num_stash_slots)
+    with pytest.raises(AssertionError):
+        sched_lib.compile_schedule("1f1b", 8, 4, stash=True)
+
+
+@pytest.mark.parametrize("stages,micros", [(2, 4), (2, 8), (4, 4), (4, 8)])
+def test_stash_peak_bounded_by_inflight_cap(stages, micros):
+    """Peak live stash count never exceeds the planner's in-flight cap
+    min(S, M) on any stage, for any pipe x gas — the analytic bound the
+    engine's pipeline.stash_budget check multiplies by per-micro bytes."""
+    rep = ba.bubble_report("zb-h1", micros, stages, stash=True)
+    cap = max(2, min(stages, micros))
+    assert all(p <= cap for p in rep["peak_live_stash"]), rep
+    # deadlock-free and still the best makespan among the three schedules
+    assert rep["makespan"] <= ba.bubble_report(
+        "1f1b", micros, stages, costs=ba.CostModel.stash())["makespan"]

@@ -146,6 +146,65 @@ def test_donation_contract_fires_and_quiets():
     hc.assert_donates(good, [0], "donated fixture")
 
 
+def test_stash_donation_contract_fires_and_quiets():
+    """The stash-donation contract pieces, on a miniature fwd-stash ->
+    wgrad handoff (a 2-layer chain + donated grad accumulator, the same
+    shape as the engine's bwd_wgrad): assert_outputs_aliased and
+    assert_params_donated fire on the undonated twin; the donating twin
+    aliases every output into donated memory and its runtime deletions
+    (assert_consumed / consumed_leaves) match the alias table exactly.
+    The buffer_donor side of assert_params_donated quiets on the real
+    SPMD-lowered engine jit in test_zb_stash_donated_into_wgrad (plain
+    single-device modules record output aliases only)."""
+    def f(p, x):
+        h = jnp.tanh(x @ p["w1"])
+        return (h @ p["w2"]).sum()
+
+    p = {"w1": jnp.ones((8, 8), jnp.float32),
+         "w2": jnp.ones((8, 8), jnp.float32)}
+    x = jnp.ones((4, 8), jnp.float32)
+    fwd = jax.jit(lambda p, x: jax.vjp(f, p, x))
+    _, stash = fwd(p, x)
+    n_stash = len(jax.tree_util.tree_leaves(stash))
+    accum = {k: jnp.zeros_like(v) for k, v in p.items()}
+
+    def wgrad(s, a):
+        return jax.tree_util.tree_map(lambda ai, gi: ai + gi, a,
+                                      s(jnp.float32(1.0))[0])
+
+    # fire: no donation — no header table mentions any input, both
+    # outputs allocate fresh, and no leaf is consumed at runtime
+    bad = jax.jit(wgrad).lower(stash, accum).compile().as_text()
+    assert hc.donated_params(bad) == set()
+    assert hc.buffer_donors(bad) == set()
+    with pytest.raises(hc.HloContractError, match="survive the call"):
+        hc.assert_params_donated(bad, range(n_stash), "undonated stash")
+    with pytest.raises(hc.HloContractError, match="copy per call"):
+        hc.assert_outputs_aliased(bad, 2, "undonated stash")
+    jax.jit(wgrad)(stash, accum)
+    with pytest.raises(hc.HloContractError, match="still live"):
+        hc.assert_consumed(stash, "undonated stash")
+
+    # quiet: the donating twin writes both outputs into donated buffers,
+    # and the runtime deletions equal the alias table's stash subset
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        good = jax.jit(wgrad, donate_argnums=(0, 1)) \
+            .lower(stash, accum).compile().as_text()
+        hc.assert_outputs_aliased(good, 2, "donated stash")
+        aliased = hc.donated_params(good)
+        assert aliased, "donating twin recorded no aliases"
+        hc.assert_params_donated(good, sorted(aliased), "donated stash")
+        jax.jit(wgrad, donate_argnums=(0, 1))(stash, accum)
+    # the table records MAY-alias: runtime deletions are a non-empty
+    # subset of the aliased stash leaves
+    deleted = hc.assert_consumed(stash, "donated stash")
+    assert deleted <= len(aliased & set(range(n_stash)))
+
+
 # ---------------------------------------------------------------------------
 # engine contracts
 # ---------------------------------------------------------------------------
@@ -244,6 +303,70 @@ def test_pipeline_boundary_activation_stays_bf16(eight_devices):
     hc.assert_no_host_transfers(hlo, "pipeline stage-0 forward jit")
     hc.assert_no_fp32_collectives(hlo, min_elements=512,
                                   what="pipeline stage-0 forward jit")
+
+
+def test_zb_stash_donated_into_wgrad(eight_devices):
+    """ISSUE 6 stash-donation contract: the activation stash (the
+    forward's vjp residuals) is donated into bwd_wgrad — the accumulator
+    leaves alias in the HLO header (no copy on the grad handoff) and
+    every stash leaf is CONSUMED at runtime (freed in place, not held to
+    the end of the batch); dgrad, the earlier consumer, must NOT consume
+    it."""
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
+    from tests.unit.simple_model import make_stack_specs, random_dataloader
+
+    specs, loss_fn, input_fn = make_stack_specs(16, 6, tied_head=False)
+    module = PipelineModule(specs, loss_fn=loss_fn, input_fn=input_fn,
+                            partition_method="uniform")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=module, config_params={
+            "train_batch_size": 8, "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "pipeline": {"schedule": "zb-h1"},
+            "mesh": {"pipe": 2, "data": 2, "model": 1,
+                     "allow_partial": True},
+            "steps_per_print": 10 ** 9})
+    data = random_dataloader(16, 64, 4)
+    engine.train_batch(data_iter=data)
+    assert engine._stash_armed
+
+    micro = next(data)
+    x = engine._put_stage(engine.module.input_fn(micro), 0)
+    rng = jax.random.fold_in(engine._pipe_rng, 0)
+    scale = np.float32(1.0)
+    jits = engine._stage_jits[0]
+    st = engine.stage_states[0]
+    with jax.set_mesh(engine._chunk_mesh(0)):
+        y, _aux, stash = jits["fwd_stash"](st.params, x, rng)
+        gy = jnp.zeros_like(y)
+        hlo = jits["bwd_wgrad_stash"].lower(stash, st.accum, gy, scale) \
+            .compile().as_text()
+        n_stash = len(jax.tree_util.tree_leaves(stash))
+        n_accum = len(jax.tree_util.tree_leaves(st.accum))
+        # HLO contracts: every new-accum output is written into donated
+        # memory (no accumulator copy on the handoff), and every stash
+        # residual leaf is donated (output-aliased or buffer donor)
+        hc.assert_outputs_aliased(hlo, n_accum,
+                                  "zb-h1 bwd_wgrad (stash handoff)")
+        hc.assert_params_donated(hlo, range(n_stash),
+                                 "zb-h1 bwd_wgrad (stash handoff)")
+        # runtime contracts: dgrad (the earlier consumer, no donation)
+        # leaves the stash fully live...
+        jits["bwd_dgrad_stash"](stash, gy, scale)
+        assert hc.consumed_leaves(stash) == (0, n_stash)
+        # ...wgrad consumes it: the deleted leaves are a non-empty
+        # subset of the may-aliased stash params (the rest are buffer
+        # donors, reusable as scratch)
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            jits["bwd_wgrad_stash"](stash, st.accum, gy, scale)
+        deleted = hc.assert_consumed(stash, "zb-h1 stash after wgrad")
+        assert deleted <= len(hc.donated_params(hlo)
+                              & set(range(n_stash)))
 
 
 def test_serving_decode_is_transfer_free_and_donates_pool(eight_devices):

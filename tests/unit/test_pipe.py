@@ -487,6 +487,122 @@ def test_pipeline_report():
         p2p["measured_bytes_per_step"]
 
 
+# ---------------------------------------------------------------------------
+# zb-h1 activation stashing (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+def test_pipe_zb_stash_armed_by_default():
+    """schedule=zb-h1 arms activation stashing by default ("auto"): the
+    forward runs once per (chunk, micro), the compiled stream carries
+    stash slots, and the report prices the stash-cost model (makespan
+    win vs 1f1b) plus per-stage stash bytes."""
+    engine, losses = _train_layers(pipe=2, dp=2, n_layers=7, steps=3,
+                                   extra={"pipeline": {"schedule": "zb-h1"}})
+    assert engine.pipe_schedule == "zb-h1"
+    assert engine._stash_armed and not engine._stash_blockers
+    compiled = engine._ensure_compiled_schedule()
+    assert compiled.stash
+    assert compiled.num_stash_slots == compiled.num_buffers
+    rep = engine.pipeline_report()
+    assert rep["stash"]["armed"] and rep["stash"]["resolved"]
+    assert rep["cost_model"]["dgrad"] == 1.0  # stash default model
+    assert all(b > 0 for b in rep["stash"]["bytes_per_micro_per_chunk"])
+    assert all(b > 0 for b in rep["stash"]["peak_bytes_per_stage"])
+    assert all(np.isfinite(losses))
+
+
+def test_pipe_zb_stash_matches_1f1b_and_remat():
+    """Parity: stashing changes WHERE gradients are computed from (saved
+    residuals vs recompute), never their values — the fp32 trajectory
+    matches both 1f1b and the remat zb-h1 split."""
+    _, base = _train_layers(pipe=4, dp=2, n_layers=7)
+    e_remat, remat = _train_layers(
+        pipe=4, dp=2, n_layers=7,
+        extra={"pipeline": {"schedule": "zb-h1",
+                            "activation_stashing": False}})
+    e_stash, stash = _train_layers(
+        pipe=4, dp=2, n_layers=7,
+        extra={"pipeline": {"schedule": "zb-h1"}})
+    assert not e_remat._stash_armed
+    assert e_stash._stash_armed
+    np.testing.assert_allclose(base, stash, rtol=2e-4)
+    # dgrad+wgrad from the SAME single forward == the remat split == the
+    # fused vjp: on the fp32 CPU mesh this holds bit-for-bit
+    assert remat == stash, f"stash diverged from remat zb: {remat} {stash}"
+
+
+def test_pipe_zb_stash_budget_fallback_warns(caplog):
+    """A stash_budget too small for the analytic peak forces fallback to
+    remat with a DISARMED warning PER affected stage naming the blocker;
+    training still matches 1f1b."""
+    import logging
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    _, base = _train_layers(pipe=2, dp=2, n_layers=7, steps=3)
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            engine, zb = _train_layers(
+                pipe=2, dp=2, n_layers=7, steps=3,
+                extra={"pipeline": {"schedule": "zb-h1",
+                                    "stash_budget": 64}})
+    finally:
+        ds_logger.propagate = False
+    assert engine.pipe_schedule == "zb-h1"      # schedule stays zb
+    assert not engine._stash_armed              # stashing fell back
+    assert not engine._ensure_compiled_schedule().stash
+    msgs = [m for m in _caplog_disarmed(caplog) if "stash" in m]
+    # one warning per over-budget stage, naming bytes and the budget
+    assert len(msgs) == 2, msgs
+    assert all("stash_budget=64" in m and "stage" in m for m in msgs)
+    np.testing.assert_allclose(base, zb, rtol=2e-4)
+
+
+@pytest.mark.parametrize("pipe,gas", [(2, 2), (2, 4), (4, 4)])
+def test_pipe_zb_stash_bytes_within_budget(pipe, gas):
+    """Stash-bound guard across pipe x gas: with a budget that admits the
+    schedule, the engine's analytic peak stash bytes (peak live stash x
+    per-micro residual bytes, per stage) stay <= pipeline.stash_budget."""
+    budget = 1 << 20
+    extra = {"pipeline": {"schedule": "zb-h1", "stash_budget": budget},
+             "gradient_accumulation_steps": gas,
+             "train_batch_size": MICRO * gas * 2}
+    engine, _ = _train_layers(pipe=pipe, dp=2, n_layers=8, steps=2,
+                              extra=extra)
+    assert engine._stash_armed
+    rep = engine.pipeline_report()
+    assert all(b <= budget for b in rep["stash"]["peak_bytes_per_stage"]), \
+        rep["stash"]
+    # the in-flight cap that sizes the bound: min(S, M) live stashes
+    cap = max(2, min(pipe, gas))
+    assert all(p <= cap for p in rep["peak_live_stash"])
+
+
+def test_pipe_stash_inert_off_zb(caplog):
+    """activation_stashing="auto" is silently inert for non-zb schedules;
+    an explicit true warns DISARMED naming the schedule."""
+    import logging
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    engine, _ = _train_layers(
+        pipe=2, dp=2, n_layers=7, steps=1,
+        extra={"pipeline": {"schedule": "interleaved", "virtual_stages": 2}})
+    assert not engine._stash_armed and not engine._stash_blockers
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            engine2, _ = _train_layers(
+                pipe=2, dp=2, n_layers=7, steps=1,
+                extra={"pipeline": {"activation_stashing": True}})
+    finally:
+        ds_logger.propagate = False
+    assert not engine2._stash_armed
+    msgs = [m for m in _caplog_disarmed(caplog) if "stashing" in m]
+    assert msgs and "1f1b" in msgs[0]
+
+
 def test_pipe_checkpoint_restage_tied(tmp_path):
     """Restage with tied embedding/head: the shared 'tied_*' weight crosses
     stage boundaries differently at pp=1 vs pp=3."""

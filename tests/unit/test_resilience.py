@@ -847,3 +847,314 @@ def test_streamed_digest_replays_chunk_parallel(tmp_path):
     pre = _take_precomputed(fname, size)
     assert pre is not None
     assert pre == chunked_checksum(fname, size, chunk_bytes=CHUNK_BYTES)
+
+
+# ---------------------------------------------------------------------------
+# async checkpoint commit (ISSUE 6): write+hash+fsync on a background
+# thread, only the atomic rename (+ latest-pointer-last) foreground
+# ---------------------------------------------------------------------------
+
+def _async_cfg(fp16=False, **res_over):
+    res = {"async_commit": True}
+    res.update(res_over)
+    return cfg(fp16=fp16, resilience=res)
+
+
+def test_async_commit_publishes_at_step_boundary(tmp_path):
+    """save_checkpoint returns with the commit in flight; the next step
+    boundary publishes it (rename + latest) without an explicit wait."""
+    e = make(_async_cfg())
+    it = steps(e, 2)
+    assert e.save_checkpoint(str(tmp_path), backend="npz")
+    assert e.pending_commit()
+    assert e._last_metrics["ckpt_commit_pending"] == 1
+    # the seal lands in the background; the following training steps'
+    # _observe_step_outcome publishes as soon as it is ready
+    deadline = __import__("time").time() + 30
+    while e.pending_commit():
+        steps(e, 1, it)
+        assert __import__("time").time() < deadline, "commit never landed"
+    assert read_latest(str(tmp_path)) == "global_step2"
+    ok, reason = verify_tag(str(tmp_path / "global_step2"))
+    assert ok, reason
+    assert e._last_metrics["ckpt_commit_pending"] == 0
+    assert e._last_metrics["ckpt_commit_ms_foreground"] > 0
+    assert not [p for p in os.listdir(tmp_path) if p.startswith(".tmp-")]
+
+
+def test_async_commit_foreground_is_rename_only(tmp_path, monkeypatch):
+    """Timed acceptance: with fsync slowed to checkpoint-scale cost
+    (BENCH_NOTES prices ~0.5 s per 250 MB), the async foreground path
+    (snapshot + rename) stays payload-time-independent while the sync
+    commit eats the full fsync bill on the training thread."""
+    import time
+
+    real_fsync = os.fsync
+    fsync_ms = 60.0
+
+    def slow_fsync(fd):
+        time.sleep(fsync_ms / 1000.0)
+        return real_fsync(fd)
+
+    e = make(_async_cfg())
+    it = steps(e, 2)
+    # sync baseline: >= 3 slowed fsyncs (manifest, payload, dir) foreground
+    monkeypatch.setattr(os, "fsync", slow_fsync)
+    t0 = time.perf_counter()
+    e.save_checkpoint(str(tmp_path), tag="sync", backend="npz",
+                      async_commit=False)
+    sync_s = time.perf_counter() - t0
+    assert sync_s >= 3 * fsync_ms / 1000.0
+
+    steps(e, 1, it)
+    t0 = time.perf_counter()
+    e.save_checkpoint(str(tmp_path), tag="async", backend="npz")
+    submit_s = time.perf_counter() - t0
+    pending = e._pending_commit
+    pending.wait(30)
+    t0 = time.perf_counter()
+    e.wait_pending_commit()
+    publish_s = time.perf_counter() - t0
+    monkeypatch.setattr(os, "fsync", real_fsync)
+    # the foreground legs dodge the payload fsyncs; publish pays only the
+    # O(1) rename + latest fsyncs (2 files + 2 dir syncs)
+    assert submit_s < sync_s / 2, (submit_s, sync_s)
+    assert publish_s < sync_s / 2, (publish_s, sync_s)
+    assert read_latest(str(tmp_path)) == "async"
+    ok, reason = verify_tag(str(tmp_path / "async"))
+    assert ok, reason
+
+
+def test_async_commit_pending_commit_class_foreground_o1(tmp_path):
+    """PendingCommit unit semantics: submit returns before a slow write
+    finishes (ready() False), finalize blocks only on the seal, and the
+    published tag verifies."""
+    import time
+
+    from deepspeed_tpu.runtime.resilience.atomic import (PendingCommit,
+                                                         atomic_tag)
+
+    write_s = 0.4
+
+    def write_fn(tmp):
+        time.sleep(write_s)   # a big payload's serialize+hash+fsync bill
+        with open(os.path.join(tmp, "payload.bin"), "wb") as f:
+            f.write(b"p" * 1024)
+
+    commit = atomic_tag(str(tmp_path), "slow", meta={"global_steps": 1})
+    t0 = time.perf_counter()
+    pending = PendingCommit(commit, write_fn).start()
+    submit_s = time.perf_counter() - t0
+    assert submit_s < write_s / 4
+    assert not pending.ready()
+    assert pending.wait(30)
+    t0 = time.perf_counter()
+    pending.finalize()
+    publish_s = time.perf_counter() - t0
+    assert publish_s < write_s / 4
+    ok, reason = verify_tag(str(tmp_path / "slow"))
+    assert ok, reason
+    assert read_latest(str(tmp_path)) == "slow"
+
+
+def test_async_commit_chaos_kill_mid_commit(tmp_path):
+    """Kill the BACKGROUND write mid-flight: the error surfaces on the
+    training thread, latest never tears, no .tmp- droppings survive, and
+    auto-resume lands on the last fully committed tag."""
+    e = make(_async_cfg())
+    it = steps(e, 2)
+    e.save_checkpoint(str(tmp_path), backend="npz")
+    e.wait_pending_commit()
+
+    steps(e, 1, it)
+    chaos.arm(kill_after_files=1)
+    e.save_checkpoint(str(tmp_path), backend="npz")  # submit succeeds
+    with pytest.raises(ChaosInterrupt):
+        e.wait_pending_commit()
+    chaos.disarm()
+    assert not e.pending_commit()
+    assert read_latest(str(tmp_path)) == "global_step2"
+    assert select_resume_tag(str(tmp_path)) == "global_step2"
+    assert not [p for p in os.listdir(tmp_path) if p.startswith(".tmp-")]
+    # the engine keeps training and checkpointing after the failed commit
+    steps(e, 1, it)
+    e.save_checkpoint(str(tmp_path), backend="npz")
+    e.wait_pending_commit()
+    assert read_latest(str(tmp_path)) == "global_step4"
+
+
+def test_async_commit_chaos_kill_between_rename_and_gc(tmp_path):
+    """Kill AFTER the rename + latest but before retention GC: the new
+    tag is already durable and visible — auto-resume lands on it; the
+    only damage is stale old tags, which the next commit's GC collects."""
+    e = make(_async_cfg(keep_checkpoint_tags=1))
+    it = steps(e, 2)
+    e.save_checkpoint(str(tmp_path), backend="npz")
+    e.wait_pending_commit()
+
+    steps(e, 2, it)
+    chaos.arm(kill_at_point="before_gc")
+    e.save_checkpoint(str(tmp_path), backend="npz")
+    with pytest.raises(ChaosInterrupt):
+        e.wait_pending_commit()
+    chaos.disarm()
+    # committed: rename + latest happened before the kill
+    assert read_latest(str(tmp_path)) == "global_step4"
+    assert select_resume_tag(str(tmp_path)) == "global_step4"
+    # GC never ran: the retention-1 policy left the old tag behind
+    assert "global_step2" in list_tags(str(tmp_path))
+    # next successful commit's GC cleans up
+    steps(e, 1, it)
+    e.save_checkpoint(str(tmp_path), backend="npz")
+    e.wait_pending_commit()
+    assert "global_step2" not in list_tags(str(tmp_path))
+
+
+def test_async_commit_backpressure_one_in_flight(tmp_path, monkeypatch):
+    """A second save while a commit is still sealing BLOCKS until the
+    first publishes — at most one commit in flight, never a reorder."""
+    import time
+
+    e = make(_async_cfg())
+    steps(e, 2)
+    orig = type(e)._write_snapshot_files
+
+    def slow_write(self, path, snap):
+        time.sleep(0.3)
+        return orig(self, path, snap)
+
+    monkeypatch.setattr(type(e), "_write_snapshot_files", slow_write)
+    e.save_checkpoint(str(tmp_path), tag="first", backend="npz")
+    assert e.pending_commit()
+    e.save_checkpoint(str(tmp_path), tag="second", backend="npz")
+    # the first commit was finalized by the second save's back-pressure
+    assert verify_tag(str(tmp_path / "first"))[0]
+    e.wait_pending_commit()
+    assert verify_tag(str(tmp_path / "second"))[0]
+    assert read_latest(str(tmp_path)) == "second"
+
+
+def test_async_commit_emergency_checkpoint_stays_synchronous(tmp_path):
+    """The watchdog's pre-abort snapshot must be durable BEFORE the alarm
+    propagates (the process is about to die): even with async_commit on,
+    the emergency tag commits synchronously."""
+    e = make(cfg(fp16=True, resilience={
+        "async_commit": True,
+        "watchdog": {"enabled": True, "max_skipped_steps": 3}}))
+    it = steps(e, 2)
+    e.save_checkpoint(str(tmp_path), backend="npz")
+    e.wait_pending_commit()
+    chaos.arm(nan_grad_steps=10)
+    with pytest.raises(WatchdogAlarm):
+        steps(e, 10, it)
+    chaos.disarm()
+    # no pending commit: the emergency tag is already on disk, verified
+    assert not e.pending_commit()
+    emer = [t for t in list_tags(str(tmp_path)) if t.startswith("emergency")]
+    assert emer
+    ok, reason = verify_tag(str(tmp_path / emer[0]))
+    assert ok, reason
+
+
+def test_async_commit_heartbeats_watchdog(tmp_path, monkeypatch):
+    """The background commit thread heartbeats the TrainingWatchdog while
+    writing/fsyncing, so a slow disk is not misdiagnosed as a training
+    stall (satellite: _last_metrics + watchdog integration)."""
+    import time
+
+    e = make(cfg(fp16=False, resilience={
+        "async_commit": True,
+        "watchdog": {"enabled": True, "stall_timeout_seconds": 3600}}))
+    steps(e, 1)
+    beats = []
+    real_hb = e.watchdog.heartbeat
+    monkeypatch.setattr(e.watchdog, "heartbeat",
+                        lambda: (beats.append(time.time()), real_hb())[1])
+    e.save_checkpoint(str(tmp_path), backend="npz")
+    e._pending_commit.wait(30)
+    # thread start + post-write + per-fsync'd-file + seal-end beats
+    assert len(beats) >= 3, beats
+    e.wait_pending_commit()
+
+
+def test_async_commit_disarms_on_orbax_and_legacy(tmp_path, caplog):
+    """Blocked async requests fall back to the synchronous commit with a
+    DISARMED warning naming the blocker (orbax backend / non-atomic
+    layout)."""
+    import logging
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    pytest.importorskip("orbax.checkpoint")
+    e = make(_async_cfg())
+    steps(e, 1)
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            e.save_checkpoint(str(tmp_path), tag="t-orbax")  # auto -> orbax
+    finally:
+        ds_logger.propagate = False
+    assert not e.pending_commit()          # committed synchronously
+    msgs = [r.message for r in caplog.records
+            if "async checkpoint commit DISARMED" in r.message]
+    assert msgs and "orbax" in msgs[0]
+    ok, reason = verify_tag(str(tmp_path / "t-orbax"))
+    assert ok, reason
+
+    e2 = make(cfg(fp16=False, resilience={"async_commit": True,
+                                          "atomic_checkpoints": False}))
+    steps(e2, 1)
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            e2.save_checkpoint(str(tmp_path / "legacy"), backend="npz")
+    finally:
+        ds_logger.propagate = False
+    assert not e2.pending_commit()
+    msgs = [r.message for r in caplog.records
+            if "async checkpoint commit DISARMED" in r.message
+            and "atomic_checkpoints" in r.message]
+    assert msgs
+
+
+def test_async_commit_pipe_engine_roundtrip(tmp_path):
+    """The pipeline engine's layer-granular payload rides the same async
+    path: snapshot (device_get of every stage) foreground, write + seal
+    background, rename foreground; a reload restores bit-exact."""
+    import jax
+
+    e = _pipe_engine()
+    it = random_dataloader(HIDDEN, 64, 4)
+    for _ in range(2):
+        e.train_batch(data_iter=it)
+    e.save_checkpoint(str(tmp_path), tag="pipe-async", backend="npz",
+                      async_commit=True)
+    assert e.pending_commit()
+    before = [np.asarray(jax.device_get(l)) for st in e.stage_states
+              for l in jax.tree_util.tree_leaves(st.params)]
+    # training continues (and donates state) while the commit seals
+    e.train_batch(data_iter=it)
+    e.wait_pending_commit()
+    ok, reason = verify_tag(str(tmp_path / "pipe-async"))
+    assert ok, reason
+    e2 = _pipe_engine()
+    e2.train_batch(data_iter=random_dataloader(HIDDEN, 64, 4, seed=9))
+    path, _ = e2.load_checkpoint(str(tmp_path), tag="pipe-async")
+    assert path is not None
+    after = [np.asarray(jax.device_get(l)) for st in e2.stage_states
+             for l in jax.tree_util.tree_leaves(st.params)]
+    for a, b in zip(before, after):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_async_commit_load_checkpoint_drains_pending(tmp_path):
+    """load_checkpoint first lands any in-flight commit, so the freshly
+    saved tag is immediately a resume candidate."""
+    e = make(_async_cfg())
+    it = steps(e, 2)
+    e.save_checkpoint(str(tmp_path), backend="npz")
+    assert e.pending_commit()
+    path, _ = e.load_checkpoint(str(tmp_path), auto_resume=True)
+    assert not e.pending_commit()
+    assert path.endswith("global_step2")
+    steps(e, 1, it)

@@ -21,7 +21,11 @@ and asserts a contract the runtime's performance claims depend on:
   input/output-alias table — XLA's rendering of jit donation.  A hot
   path that claims in-place state update (the training micro-step's
   TrainState, the serving engine's KV pool) must actually alias its
-  buffers, or every step silently pays a full-state copy.
+  buffers, or every step silently pays a full-state copy;
+- ``assert_consumed``: the RUNTIME half of the donation contract, for
+  donated buffers that alias no output (the zb-h1 activation stash
+  flowing into ``bwd_wgrad``): after the donating call, every leaf must
+  be ``is_deleted()`` — freed in place, not surviving to peak memory.
 
 Wired as tier-1 tests in tests/unit/test_hlo_contracts.py; deterministic
 on the CPU mesh — no accelerator needed.
@@ -155,15 +159,12 @@ def assert_collective_budget(hlo_text: str, budget_bytes: int,
     return total
 
 
-def donated_params(hlo_text: str) -> set:
-    """Parameter numbers aliased to outputs (jax donation), parsed from
-    the module header's ``input_output_alias={ {0}: (2, {}, may-alias) }``
-    table — entries map output tuple index -> (param number, param index
-    path, kind)."""
-    start = hlo_text.find("input_output_alias={")
+def _header_table(hlo_text: str, key: str) -> Optional[str]:
+    """Body of a ``key={...}`` module-header table (balanced-brace scan:
+    entries themselves contain nested {}), or None when absent."""
+    start = hlo_text.find(key + "={")
     if start < 0:
-        return set()
-    # balanced-brace scan: entries themselves contain nested {}
+        return None
     i = hlo_text.index("{", start)
     depth, j = 0, i
     for j in range(i, len(hlo_text)):
@@ -173,9 +174,75 @@ def donated_params(hlo_text: str) -> set:
             depth -= 1
             if depth == 0:
                 break
-    body = hlo_text[i + 1:j]
+    return hlo_text[i + 1:j]
+
+
+def donated_params(hlo_text: str) -> set:
+    """Parameter numbers aliased to outputs (jax donation), parsed from
+    the module header's ``input_output_alias={ {0}: (2, {}, may-alias) }``
+    table — entries map output tuple index -> (param number, param index
+    path, kind)."""
+    body = _header_table(hlo_text, "input_output_alias")
+    if body is None:
+        return set()
     return {int(m.group(1))
             for m in re.finditer(r"\}\s*:\s*\((\d+)", body)}
+
+
+def aliased_outputs(hlo_text: str) -> set:
+    """OUTPUT tuple indices that alias a donated input — the other side
+    of the input_output_alias table.  An output index present here is
+    written into a donated buffer: no fresh allocation, no copy.  A
+    non-tuple output renders as ``{}`` and reports index 0."""
+    body = _header_table(hlo_text, "input_output_alias")
+    if body is None:
+        return set()
+    return {int(m.group(1) or 0)
+            for m in re.finditer(r"\{\s*(\d*)\s*\}\s*:\s*\(", body)}
+
+
+def buffer_donors(hlo_text: str) -> set:
+    """Parameter numbers in the ``buffer_donor={ (4, {}), ... }`` table:
+    donated inputs that alias NO output but whose buffers XLA may still
+    consume in place (scratch reuse) — how a donated zb-h1 stash residual
+    that matches no output shape shows up in the compiled module."""
+    body = _header_table(hlo_text, "buffer_donor")
+    if body is None:
+        return set()
+    return {int(m.group(1)) for m in re.finditer(r"\(\s*(\d+)\s*,", body)}
+
+
+def assert_outputs_aliased(hlo_text: str, n_outputs: int,
+                           what: str = "jit") -> None:
+    """Every output 0..n_outputs-1 must be written into a donated input
+    buffer (input_output_alias covers the full result tuple): the
+    'no copy on the handoff' half of the stash-donation contract — a
+    missing entry means that result pays a fresh allocation per call."""
+    got = aliased_outputs(hlo_text)
+    missing = [i for i in range(n_outputs) if i not in got]
+    if missing:
+        raise HloContractError(
+            f"HLO contract: every output of {what} must alias a donated "
+            f"input, but output(s) {missing} of {n_outputs} allocate "
+            f"fresh buffers (aliased: {sorted(got) or 'none'}) — the "
+            f"handoff pays a copy per call")
+
+
+def assert_params_donated(hlo_text: str, param_indices,
+                          what: str = "jit") -> None:
+    """Every parameter in ``param_indices`` must be donated — either
+    output-aliased (input_output_alias) or a registered buffer donor
+    (reusable in place).  The compiled rendering of donate_argnums over
+    buffers that may or may not match an output shape, e.g. the zb-h1
+    stash flowing into bwd_wgrad."""
+    got = donated_params(hlo_text) | buffer_donors(hlo_text)
+    missing = sorted(set(int(p) for p in param_indices) - got)
+    if missing:
+        raise HloContractError(
+            f"HLO contract: {what} must donate parameter(s) {missing} "
+            f"(output alias or buffer donor), but the compiled module "
+            f"only donates {sorted(got) or 'none'} — those buffers "
+            f"survive the call at peak memory")
 
 
 def assert_donates(hlo_text: str, param_indices, what: str = "jit") -> None:
@@ -192,6 +259,37 @@ def assert_donates(hlo_text: str, param_indices, what: str = "jit") -> None:
             f"(input/output alias), but the compiled module only aliases "
             f"{sorted(got) or 'none'} — the 'in-place' update is paying "
             f"a full copy per call")
+
+
+def consumed_leaves(tree) -> tuple:
+    """(deleted, total) jax-array leaves of ``tree`` — the runtime trace
+    of donation: a leaf the executable output-aliased is invalidated
+    (``is_deleted()``) after the call; donated-but-donor-only leaves stay
+    readable on some backends, so the HLO tables above are the complete
+    contract and this is its observable subset."""
+    import jax
+
+    leaves = [l for l in jax.tree_util.tree_leaves(tree)
+              if isinstance(l, jax.Array)]
+    return sum(1 for l in leaves if l.is_deleted()), len(leaves)
+
+
+def assert_consumed(tree, what: str = "donated argument",
+                    min_leaves: int = 1) -> int:
+    """At least ``min_leaves`` array leaves of ``tree`` must be DELETED
+    after the donating call (see :func:`consumed_leaves`).  Call it on
+    the argument passed to a ``donate_argnums`` jit: zero consumed
+    leaves means the donation silently didn't happen and every 'freed in
+    place' buffer survives to peak memory.  Returns the consumed
+    count."""
+    deleted, total = consumed_leaves(tree)
+    if deleted < min_leaves:
+        raise HloContractError(
+            f"HLO contract: {what} must be consumed by its donating jit "
+            f"(>= {min_leaves} leaves), but only {deleted}/{total} array "
+            f"leaves are deleted — the donation was dropped and the "
+            f"buffers are still live after the call")
+    return deleted
 
 
 def entry_output_dtypes(hlo_text: str) -> Optional[List[str]]:

@@ -56,8 +56,23 @@ def main():
     p.add_argument("--untied-head", action="store_true",
                    help="untie the LM head from the embedding (zb-h1 is "
                         "blocked by tied weights)")
+    p.add_argument("--cost-model", default="remat",
+                   choices=["remat", "stash"],
+                   help="analytic cost model: 'remat' prices each zb split "
+                        "pass with its own forward recompute (d=w=1.5); "
+                        "'stash' prices the activation-stashing engine "
+                        "(d=w=1, forward runs once) and requires the "
+                        "schedule to be compiled WITH stash slots — "
+                        "implies pipeline.activation_stashing")
+    p.add_argument("--stash-budget", type=int, default=0,
+                   help="pipeline.stash_budget bytes per stage (0 = "
+                        "unbounded); over-budget stages DISARM stashing")
     p.add_argument("--real-tpu", action="store_true")
     args = p.parse_args()
+    if args.cost_model == "stash" and args.schedule != "zb-h1":
+        p.error("--cost-model stash requires --schedule zb-h1 (only the "
+                "zb split backward consumes a stash; fused schedules "
+                "already recompute exactly once)")
 
     if _CPU_MODE:
         jax.config.update("jax_platforms", "cpu")
@@ -92,7 +107,12 @@ def main():
               "mesh": {"pipe": pipe, "data": dp},
               "pipeline": {"schedule": args.schedule if pipe > 1 else "1f1b",
                            "virtual_stages": args.virtual_stages
-                           if pipe > 1 else 1},
+                           if pipe > 1 else 1,
+                           # the flag picks the VARIANT measured+priced:
+                           # 'remat' forces the recompute split backward
+                           # even though the engine's default is auto-stash
+                           "activation_stashing": args.cost_model == "stash",
+                           "stash_budget": args.stash_budget},
               "steps_per_print": 10 ** 9}
         model = gpt2_pipeline_module(cfg, partition_method="uniform",
                                      untied_head=args.untied_head) \
@@ -103,6 +123,18 @@ def main():
         batch = {"input_ids": ids, "labels": ids.copy()}
         loss = engine.train_batch(batch=batch)       # compile
         float(jax.device_get(loss))
+        if pipe > 1 and args.cost_model == "stash" \
+                and not engine._ensure_compiled_schedule().stash:
+            # refuse BEFORE the timed loop: stash accounting against a
+            # remat stream would price work the engine is not doing
+            print(f"ERROR: --cost-model stash, but the compiled "
+                  f"'{engine.pipe_schedule}' schedule carries no stash "
+                  f"slots (stashing DISARMED: "
+                  f"{'; '.join(engine._stash_blockers) or 'schedule fell back'}); "
+                  f"fix the blockers (e.g. --untied-head, a larger "
+                  f"--stash-budget) or use --cost-model remat",
+                  file=sys.stderr, flush=True)
+            sys.exit(2)
         t0 = time.time()
         for _ in range(args.steps):
             loss = engine.train_batch(batch=batch)
@@ -116,6 +148,7 @@ def main():
             # (first/last stages omit recv/send legs, so stage 0 x pipe
             # would overcount); host enqueue cost timed against each
             # stage's actual submesh device
+            compiled = engine._ensure_compiled_schedule()
             sim = engine.pipeline_report()
             sim_eq = engine.pipeline_report(
                 costs=ba.CostModel.equal_fwd_bwd())
@@ -137,6 +170,7 @@ def main():
             out.update({
                 "schedule": engine.pipe_schedule,
                 "virtual_stages": engine.virtual_stages,
+                "cost_model": args.cost_model,
                 "instructions_per_step": n_instr,
                 "enqueue_us_per_dispatch": round(enqueue_us, 1),
                 "host_dispatch_ms_per_step":
@@ -145,12 +179,26 @@ def main():
                     round(sim["bubble_fraction"], 3),
                 "analytic_bubble_fraction_equal_fb":
                     round(sim_eq["bubble_fraction"], 3),
+                "analytic_makespan": round(sim["makespan"], 2),
                 "ideal_1f1b_bubble_fraction":
                     round(ba.ideal_1f1b_bubble(gas, pipe), 3),
                 "p2p_bytes_per_step":
                     sim["p2p"]["measured_bytes_per_step"],
                 "peak_live_buffers": sim["peak_live_buffers"],
             })
+            if compiled.stash:
+                # the memory bill next to the analytic bubble: what the
+                # stashing win costs in held residual bytes per stage
+                out.update({
+                    "stash_armed": True,
+                    "stash_peak_bytes_per_stage":
+                        sim["stash"]["peak_bytes_per_stage"],
+                    "stash_bytes_per_micro_per_chunk":
+                        sim["stash"]["bytes_per_micro_per_chunk"],
+                    "peak_live_stash": sim["peak_live_stash"],
+                })
+            elif engine.pipe_schedule == "zb-h1":
+                out["stash_armed"] = False
         print(json.dumps(out), flush=True)
         return step_ms
 

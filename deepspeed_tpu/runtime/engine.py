@@ -161,6 +161,11 @@ class DeepSpeedEngine:
         self._pending_commit_info = None
         self._ckpt_foreground_ms = 0.0
         self._ckpt_metrics = None
+        # graceful preemption: the flag is set by request_preemption()
+        # (signal-handler safe); the coordinated save + GracefulPreemption
+        # raise happen at the next optimizer-step boundary
+        self._preempt_requested = False
+        self._preempt_poll_enabled = False
         self._watchdog = None
         if res.watchdog_enabled:
             from deepspeed_tpu.runtime.resilience.watchdog import \
@@ -2343,16 +2348,110 @@ class DeepSpeedEngine:
             metrics["ckpt_commit_pending"] = \
                 int(self._pending_commit is not None)
             self._last_metrics = metrics
-        if self._watchdog is None:
-            return
-        from deepspeed_tpu.runtime.resilience.watchdog import WatchdogAlarm
+        if self._watchdog is not None:
+            from deepspeed_tpu.runtime.resilience.watchdog import \
+                WatchdogAlarm
 
-        try:
-            self._watchdog.observe_step(self.global_steps, loss=loss,
-                                        overflow=bool(overflow))
-        except WatchdogAlarm as alarm:
-            self._emergency_checkpoint(alarm.event)
-            raise
+            try:
+                self._watchdog.observe_step(self.global_steps, loss=loss,
+                                            overflow=bool(overflow))
+            except WatchdogAlarm as alarm:
+                self._emergency_checkpoint(alarm.event)
+                raise
+        self._maybe_preempt()
+
+    # ------------------------------------------------------------------
+    # graceful preemption (topology-elastic restart, ISSUE 7)
+    # ------------------------------------------------------------------
+    def request_preemption(self):
+        """Ask for a graceful shutdown: at the next optimizer-step
+        boundary the engine writes a synchronous, atomically committed
+        ``preempt_step<N>`` checkpoint (multi-host coordinated via the
+        all_agree discipline) and raises
+        :class:`~deepspeed_tpu.runtime.resilience.watchdog.GracefulPreemption`.
+        Signal-handler safe: only sets a flag."""
+        self._preempt_requested = True
+        self._preempt_poll_enabled = True
+
+    def install_preemption_handler(self, signals=None):
+        """Route SIGTERM (the preemption notice on TPU pods) into
+        :meth:`request_preemption`.  Call it on EVERY process of a
+        multi-host run — the per-step preemption poll is a collective
+        (coordination.any_flag), so a host that never armed it would
+        leave peers waiting in the agreement.  Main thread only (a
+        Python signal-handler constraint)."""
+        import signal as signal_mod
+
+        sigs = tuple(signals) if signals else (signal_mod.SIGTERM,)
+        for s in sigs:
+            signal_mod.signal(s, lambda *_a: self.request_preemption())
+        self._preempt_poll_enabled = True
+        log_dist(f"preemption handler installed for "
+                 f"{[signal_mod.Signals(s).name for s in sigs]}", ranks=[0])
+
+    def _maybe_preempt(self):
+        """Step-boundary preemption poll: OR the local request flag with
+        an armed chaos ``preempt_after_steps`` plan, agree across hosts
+        (any rank's signal preempts everyone), then save + raise.  The
+        collective poll only runs once preemption is armed on this host
+        — an idle multi-host run pays nothing."""
+        import jax
+
+        from deepspeed_tpu.runtime.resilience import chaos
+
+        want = self._preempt_requested
+        if chaos.active() is not None and chaos.consume_preempt_step():
+            want = True
+        if jax.process_count() > 1:
+            if not (self._preempt_poll_enabled or chaos.active() is not None):
+                return
+            from deepspeed_tpu.runtime.resilience.coordination import \
+                any_flag
+
+            want = any_flag(want)
+        if not want:
+            return
+        self._preempt_requested = True  # latch (peer-initiated preempts)
+        tag, save_dir = self._preempt_checkpoint()
+        from deepspeed_tpu.runtime.resilience.watchdog import \
+            GracefulPreemption
+
+        raise GracefulPreemption(
+            f"graceful preemption at step {self.global_steps}"
+            + (f": committed checkpoint tag {tag!r} under {save_dir}"
+               if tag else " (no checkpoint directory known; state NOT "
+                          "saved)"),
+            tag=tag, save_dir=save_dir)
+
+    def _preempt_checkpoint(self):
+        """The forced pre-shutdown save: synchronous (the process is
+        about to exit — a background commit thread would die with it),
+        atomic, ``latest``-updating (unlike watchdog emergency tags this
+        state is HEALTHY, so restarts should resume from it), with the
+        exact data position in client_state so the restart neither
+        replays nor skips samples.  Returns ``(tag, save_dir)``."""
+        from deepspeed_tpu.runtime.resilience import reshard
+
+        # the run's own checkpoint dir FIRST (opposite of the watchdog's
+        # emergency preference): the preempt tag holds healthy state and
+        # updates `latest`, so it must land where restarts actually look;
+        # the emergency dir is only the fallback for never-saved runs
+        save_dir = self._last_ckpt_dir \
+            or self._resilience.watchdog_emergency_dir
+        if not save_dir:
+            logger.warning(
+                "graceful preemption: no prior save_checkpoint dir and no "
+                "resilience.watchdog.emergency_checkpoint_dir configured; "
+                "shutting down WITHOUT a checkpoint")
+            return None, None
+        tag = f"preempt_step{self.global_steps}"
+        self.save_checkpoint(
+            save_dir, tag=tag,
+            client_state={"data_position": reshard.data_position(self)},
+            manifest_meta={"preempt": True}, async_commit=False)
+        log_dist(f"graceful preemption: committed {tag!r} under "
+                 f"{save_dir}", ranks=[0])
+        return tag, save_dir
 
     def _emergency_checkpoint(self, event=None):
         """Final checkpoint before a watchdog abort tears the run down."""
@@ -2386,10 +2485,16 @@ class DeepSpeedEngine:
             # emergency tag is kept for postmortem and as a last resort.
             # async_commit=False: the process is about to die on the
             # WatchdogAlarm — a background commit thread would die with
-            # it, so the final snapshot commits synchronously
+            # it, so the final snapshot commits synchronously.
+            # data_position in client_state: the postmortem restart must
+            # know the exact sample offset, or it replays/skips data
+            from deepspeed_tpu.runtime.resilience import reshard
+
             self.save_checkpoint(save_dir,
                                  tag=f"emergency_step{self.global_steps}",
                                  save_latest=False,
+                                 client_state={"data_position":
+                                               reshard.data_position(self)},
                                  manifest_meta={"emergency": True},
                                  async_commit=False)
         except Exception as e:
@@ -2503,6 +2608,8 @@ class DeepSpeedEngine:
                 off_leaves = [np.array(l, copy=True) for l in off_leaves]
             snap["off_leaves"] = off_leaves
             snap["opt_step"] = self._host_opt["step"]
+        from deepspeed_tpu.runtime.resilience import reshard
+
         snap["meta"] = {
             "global_steps": self.global_steps,
             "micro_steps": self.micro_steps,
@@ -2513,6 +2620,8 @@ class DeepSpeedEngine:
             if self.lr_scheduler is not None else None,
             "client_state": client_state,
             "num_leaves": snap["num_leaves"],
+            reshard.TOPOLOGY_KEY: reshard.topology_manifest(self),
+            reshard.DATA_POSITION_KEY: reshard.data_position(self),
         }
         return snap
 
@@ -2597,15 +2706,23 @@ class DeepSpeedEngine:
         if self._resilience.atomic_checkpoints:
             from deepspeed_tpu.runtime.resilience.atomic import savez_hashed
 
-            savez_hashed(fname, **arrays)
+            # commit-path helper: callers are the chaos-hooked snapshot
+            # writers targeting the atomic temp dir
+            savez_hashed(fname, **arrays)  # graftlint: disable=raw-ckpt-write
         else:
-            np.savez(fname, **arrays)
+            # the sanctioned legacy (resilience.atomic_checkpoints=false)
+            # in-place layout — unprotected by design, documented as such
+            np.savez(fname, **arrays)  # graftlint: disable=raw-ckpt-write
 
     def _checkpoint_manifest_meta(self, tag):
         """World/step metadata recorded in the tag manifest (human- and
         tooling-readable without unpickling the payload).  The "backend"
         key is filled in by save_checkpoint once the payload write has
-        resolved it."""
+        resolved it.  "topology" + "data_position" make the tag
+        topology-elastic: any mesh can read what layout wrote it and
+        where the sample stream stood (resilience/reshard.py)."""
+        from deepspeed_tpu.runtime.resilience import reshard
+
         return {
             "tag": str(tag),
             "global_steps": self.global_steps,
@@ -2615,6 +2732,8 @@ class DeepSpeedEngine:
                 "mp": self.mp_world_size,
                 "sp": self.sp_world_size,
             },
+            reshard.TOPOLOGY_KEY: reshard.topology_manifest(self),
+            reshard.DATA_POSITION_KEY: reshard.data_position(self),
         }
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
@@ -2936,7 +3055,8 @@ class DeepSpeedEngine:
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True,
-                        load_lr_scheduler_states=True, auto_resume=None):
+                        load_lr_scheduler_states=True, auto_resume=None,
+                        elastic=None):
         """Restore from ``load_dir``.
 
         tag=None loads the ``latest``-pointed tag.  With
@@ -2947,7 +3067,17 @@ class DeepSpeedEngine:
         intact checkpoint loads; returns (None, {}) when nothing intact
         exists.  An explicitly named tag is never second-guessed: it
         loads, or raises CheckpointCorrupt (never loads bad bytes
-        silently, never substitutes a different tag)."""
+        silently, never substitutes a different tag).
+
+        ``elastic=True`` makes a cross-topology restore explicit: the
+        checkpoint's topology manifest is diffed against the live mesh
+        (resilience/reshard.py), resharding actions are logged, schedule
+        features the new topology drops DISARM-warn, the elastic batch
+        config is verified against compute_elastic_config, and the
+        returned client_state gains the reshard report + the exact data
+        position (``data_position`` / ``micro_batches_to_skip``) so the
+        sample stream resumes without replay.  Auto-resume is always
+        elastic — a restart is exactly when the mesh may have changed."""
         from deepspeed_tpu.runtime.resilience import atomic as atomic_lib
         from deepspeed_tpu.runtime.resilience.atomic import CheckpointCorrupt
 
@@ -2966,9 +3096,12 @@ class DeepSpeedEngine:
         elif auto_resume is None:
             auto_resume = res.auto_resume
         if auto_resume:
+            # a restart is exactly when the topology may have changed;
+            # elastic=False opts out explicitly
             return self._auto_resume_load(load_dir, load_module_strict,
                                           load_optimizer_states,
-                                          load_lr_scheduler_states)
+                                          load_lr_scheduler_states,
+                                          elastic=elastic is not False)
 
         if tag is None:
             tag = atomic_lib.read_latest(load_dir)
@@ -2999,10 +3132,12 @@ class DeepSpeedEngine:
                     f"back to the newest intact checkpoint.")
         return self._load_checkpoint_tag(load_dir, tag, load_module_strict,
                                          load_optimizer_states,
-                                         load_lr_scheduler_states)
+                                         load_lr_scheduler_states,
+                                         elastic=bool(elastic))
 
     def _auto_resume_load(self, load_dir, load_module_strict,
-                          load_optimizer_states, load_lr_scheduler_states):
+                          load_optimizer_states, load_lr_scheduler_states,
+                          elastic=True):
         """Newest-first scan that falls back past corrupt/unloadable tags.
 
         Multi-process: process 0 alone selects each candidate (so every
@@ -3059,7 +3194,8 @@ class DeepSpeedEngine:
             try:
                 result = self._load_checkpoint_tag(
                     load_dir, cand, load_module_strict,
-                    load_optimizer_states, load_lr_scheduler_states)
+                    load_optimizer_states, load_lr_scheduler_states,
+                    elastic=elastic)
             except Exception as e:
                 err = e
             ok, _ = all_agree(err is None)
@@ -3141,7 +3277,7 @@ class DeepSpeedEngine:
 
     def _load_checkpoint_tag(self, load_dir, tag, load_module_strict=True,
                              load_optimizer_states=True,
-                             load_lr_scheduler_states=True):
+                             load_lr_scheduler_states=True, elastic=False):
         import jax
 
         # imported here (not in the npz branch) because the offload restore
@@ -3235,7 +3371,31 @@ class DeepSpeedEngine:
         if self._watchdog is not None:
             # mid-run restores can take minutes; not a stalled step
             self._watchdog.heartbeat()
-        return path, meta.get("client_state", {})
+        return path, self._elastic_client_state(meta, elastic)
+
+    def _elastic_client_state(self, meta, elastic):
+        """client_state returned by a load, with the elastic reshard
+        report + exact data position attached when the load was elastic.
+        A non-elastic cross-topology load still works (the payloads are
+        topology-independent) but gets one info line pointing at
+        elastic=True instead of the full plan."""
+        from deepspeed_tpu.runtime.resilience import reshard
+
+        client = dict(meta.get("client_state") or {})
+        if elastic:
+            report = reshard.elastic_load_report(meta, self)
+            client["elastic_reshard"] = report
+            client.setdefault(reshard.DATA_POSITION_KEY,
+                              meta.get(reshard.DATA_POSITION_KEY))
+        else:
+            saved = (meta.get(reshard.TOPOLOGY_KEY) or {})
+            if saved.get("dp") not in (None, self.dp_world_size):
+                log_dist(
+                    f"checkpoint was written at dp={saved.get('dp')}, now "
+                    f"dp={self.dp_world_size}; pass elastic=True to "
+                    f"load_checkpoint for the verified reshard plan + "
+                    f"data-position resume", ranks=[0])
+        return client
 
     def init_from_batch(self, batch):
         """Explicitly build train state from a sample batch (e.g. before

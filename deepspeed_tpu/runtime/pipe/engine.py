@@ -1239,6 +1239,8 @@ class PipelineEngine(DeepSpeedEngine):
         snapshot (nothing mutates them in place)."""
         import jax
 
+        from deepspeed_tpu.runtime.resilience import reshard
+
         host_states = [jax.device_get(self._stage_save_tree(st))
                        for st in self.stage_states]
         meta = {
@@ -1256,6 +1258,9 @@ class PipelineEngine(DeepSpeedEngine):
             "lr_scheduler": self.lr_scheduler.state_dict()
             if self.lr_scheduler is not None else None,
             "client_state": client_state,
+            "dp_world_size": self.dp_world_size,
+            reshard.TOPOLOGY_KEY: reshard.topology_manifest(self),
+            reshard.DATA_POSITION_KEY: reshard.data_position(self),
         }
         return {"host_states": host_states, "meta": meta,
                 "backend": "npz-layer"}
@@ -1321,7 +1326,7 @@ class PipelineEngine(DeepSpeedEngine):
 
     def _load_checkpoint_tag(self, load_dir, tag, load_module_strict=True,
                              load_optimizer_states=True,
-                             load_lr_scheduler_states=True):
+                             load_lr_scheduler_states=True, elastic=False):
         import jax
 
         path = os.path.join(load_dir, str(tag))
@@ -1376,5 +1381,9 @@ class PipelineEngine(DeepSpeedEngine):
         if load_lr_scheduler_states and self.lr_scheduler is not None \
                 and meta.get("lr_scheduler") is not None:
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
-        log_dist(f"Loaded pipeline checkpoint {path}", ranks=[0])
-        return path, meta.get("client_state", {})
+        log_dist(f"Loaded pipeline checkpoint {path} (saved at "
+                 f"{meta['num_stages']}x{meta.get('virtual_stages', 1)} "
+                 f"chunks/{meta.get('schedule')}, now "
+                 f"{self.num_stages}x{self.virtual_stages}/"
+                 f"{self.pipe_schedule})", ranks=[0])
+        return path, self._elastic_client_state(meta, elastic)

@@ -12,25 +12,34 @@ collective.  This package makes those survivable:
   poison grads) used by tests/unit/test_resilience.py to prove recovery.
 - ``coordination``: the multi-host agree/broadcast discipline the engine
   save/load paths share (fail together, never wedge peers in a barrier).
+- ``reshard``: topology-elastic resume — every checkpoint carries a
+  topology manifest + exact data position, and
+  ``load_checkpoint(elastic=True)`` reshards it onto ANY mesh (new zero
+  axis, remapped pipeline chunks, schedule downgrades DISARM-warned),
+  with ``compute_elastic_config`` preserving the global batch.
 """
 from deepspeed_tpu.runtime.resilience.atomic import (MANIFEST_NAME,
                                                      CheckpointCorrupt,
                                                      atomic_tag, gc_tags,
                                                      is_emergency_tag,
+                                                     is_preempt_tag,
                                                      list_tags, load_manifest,
                                                      read_latest,
+                                                     read_topology,
                                                      resume_candidates,
                                                      select_resume_tag,
                                                      verify_tag, write_latest,
                                                      write_manifest)
-from deepspeed_tpu.runtime.resilience.watchdog import (TrainingWatchdog,
+from deepspeed_tpu.runtime.resilience.watchdog import (GracefulPreemption,
+                                                       TrainingWatchdog,
                                                        WatchdogAlarm,
                                                        WatchdogEvent)
 
 __all__ = [
     "MANIFEST_NAME", "CheckpointCorrupt", "atomic_tag", "gc_tags",
-    "is_emergency_tag", "list_tags", "load_manifest", "read_latest",
-    "resume_candidates", "select_resume_tag",
-    "verify_tag", "write_latest", "write_manifest",
-    "TrainingWatchdog", "WatchdogAlarm", "WatchdogEvent",
+    "is_emergency_tag", "is_preempt_tag", "list_tags", "load_manifest",
+    "read_latest", "read_topology", "resume_candidates",
+    "select_resume_tag", "verify_tag", "write_latest", "write_manifest",
+    "GracefulPreemption", "TrainingWatchdog", "WatchdogAlarm",
+    "WatchdogEvent",
 ]

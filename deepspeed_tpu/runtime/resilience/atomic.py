@@ -656,6 +656,25 @@ def _emergency_from_manifest(tag, manifest):
     return str(tag).startswith("emergency_")
 
 
+def read_topology(tag_dir):
+    """The tag's topology manifest (mesh/zero/pipe/schedule layout the
+    writing run used — see resilience/reshard.py), readable by tooling
+    without unpickling any payload.  None for pre-elastic checkpoints."""
+    manifest = load_manifest(tag_dir)
+    if manifest is None:
+        return None
+    return manifest.get("topology")
+
+
+def is_preempt_tag(save_dir, tag):
+    """True for graceful-preemption snapshots (manifest ``preempt``
+    flag).  Unlike emergency tags these hold HEALTHY state — they update
+    ``latest`` and resume first like any normal tag; the flag only
+    records why the run stopped."""
+    manifest = load_manifest(os.path.join(save_dir, str(tag)))
+    return bool(manifest.get("preempt")) if manifest else False
+
+
 def is_emergency_tag(save_dir, tag):
     """True for the watchdog's pre-abort snapshots: the manifest's
     ``emergency`` flag when present, else (legacy non-atomic layout writes

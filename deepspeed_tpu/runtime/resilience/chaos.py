@@ -24,13 +24,15 @@ class ChaosPlan:
 
     def __init__(self, kill_after_files=None, kill_at_point=None,
                  corrupt_after_files=None, corrupt_nbytes=4,
-                 nan_grad_steps=0, cancel_request_every=0):
+                 nan_grad_steps=0, cancel_request_every=0,
+                 preempt_after_steps=0):
         self.kill_after_files = kill_after_files
         self.kill_at_point = kill_at_point
         self.corrupt_after_files = corrupt_after_files
         self.corrupt_nbytes = corrupt_nbytes
         self.nan_grad_steps = nan_grad_steps
         self.cancel_request_every = cancel_request_every
+        self.preempt_after_steps = preempt_after_steps
         self.files_written = 0
         self.fired = []
         self._lock = threading.Lock()
@@ -53,6 +55,12 @@ def arm(**kwargs):
     cancel_request_every=N  have the serving scheduler cancel its youngest
                          running request every Nth step (request-churn
                          chaos for the continuous-batching engine).
+    preempt_after_steps=N  deliver a graceful-preemption signal (the
+                         SIGTERM analog) after N more optimizer steps:
+                         the engine forces a synchronous emergency save
+                         and raises GracefulPreemption.  Combine with
+                         kill_at_point to model a hard kill landing
+                         MID-preempt-save.
     """
     global _plan
     _plan = ChaosPlan(**kwargs)
@@ -124,6 +132,54 @@ def record_serving_cancel(rid):
             _plan.fired.append(("cancel_request", rid))
 
 
+def consume_preempt_step():
+    """One optimizer step toward an armed graceful preemption; True on
+    the step the budget exhausts — the engine must then run its preempt
+    checkpoint and raise GracefulPreemption.  Fires once; the engine
+    latches its own request flag (a real SIGTERM does not un-deliver
+    itself), so repeated polls need no chaos state."""
+    if _plan is None or _plan.preempt_after_steps <= 0:
+        return False
+    with _plan._lock:
+        _plan.preempt_after_steps -= 1
+        if _plan.preempt_after_steps > 0:
+            return False
+        _plan.preempt_after_steps = 0
+        if not any(kind == "preempt" for kind, _ in _plan.fired):
+            _plan.fired.append(("preempt", None))
+    return True
+
+
+def preempt_then_resume(run_fn, resume_fn, preempt_after_steps,
+                        kill_at_point=None, **extra_arm):
+    """Scenario driver: graceful-preempt a training run, then restart it
+    (typically on a SMALLER mesh) — the elastic analog of PR 1's
+    kill-mid-write chaos tests.
+
+    ``run_fn()`` drives training until the armed preemption interrupts
+    it (GracefulPreemption after the forced save; ChaosInterrupt when
+    ``kill_at_point`` models a hard kill landing mid-save).  Chaos is
+    disarmed, then ``resume_fn()`` builds the restart-world engine and
+    resumes.  Returns ``(resume_result, interrupt)`` so the test can
+    assert both the landing checkpoint and the interrupt kind.
+    """
+    from deepspeed_tpu.runtime.resilience.watchdog import GracefulPreemption
+
+    arm(preempt_after_steps=preempt_after_steps,
+        kill_at_point=kill_at_point, **extra_arm)
+    interrupt = None
+    try:
+        run_fn()
+        raise AssertionError(
+            "chaos preempt scenario: run_fn returned without the armed "
+            "preemption firing — not enough steps?")
+    except (GracefulPreemption, ChaosInterrupt) as e:
+        interrupt = e
+    finally:
+        disarm()
+    return resume_fn(), interrupt
+
+
 def consume_nan_grad_step():
     """One poisoned optimizer step; returns True while the budget lasts."""
     if _plan is None or _plan.nan_grad_steps <= 0:
@@ -135,7 +191,8 @@ def consume_nan_grad_step():
 
 def corrupt_file(path, offset=0, nbytes=4):
     """Flip ``nbytes`` bytes of ``path`` in place (silent bit rot)."""
-    with open(path, "r+b") as f:
+    # intentional corruption — the write the manifest checksums must catch
+    with open(path, "r+b") as f:  # graftlint: disable=raw-ckpt-write
         f.seek(offset)
         chunk = f.read(nbytes)
         f.seek(offset)
@@ -145,6 +202,7 @@ def corrupt_file(path, offset=0, nbytes=4):
 
 def truncate_file(path, keep_bytes=0):
     """Truncate ``path`` to ``keep_bytes`` (partial write / torn page)."""
-    with open(path, "r+b") as f:
+    # intentional torn-page injection; size check must catch it
+    with open(path, "r+b") as f:  # graftlint: disable=raw-ckpt-write
         f.truncate(keep_bytes)
     logger.warning(f"chaos: truncated {path} to {keep_bytes} bytes")

@@ -33,6 +33,27 @@ def all_agree(ok):
     return bool(int(np.min(flags))), int(len(flags) - np.sum(flags))
 
 
+def any_flag(flag):
+    """Allgather-OR of a local boolean; True when ANY process set it.
+
+    The preemption counterpart of :func:`all_agree`: a SIGTERM (or chaos
+    preempt trigger) may land on one host first, but the emergency save
+    it forces is collective — every rank must enter it together, so the
+    local flags are OR-combined at the step boundary.  Single process:
+    passthrough with no collective.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return bool(flag)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray([bool(flag)], np.int32))
+    return bool(int(np.max(flags)))
+
+
 def broadcast_tag(name):
     """Broadcast a tag name (or None) from process 0 to every host.
 

@@ -1,0 +1,431 @@
+"""Topology-elastic checkpoint resharding: save once, resume on any mesh.
+
+The npz checkpoint families already store topology-independent payloads
+(full unsharded leaves for the base engine, layer-keyed files for the
+pipeline engine), so in principle any mesh can load them.  This module
+makes that guarantee EXPLICIT and verified instead of accidental:
+
+- every checkpoint carries a **topology manifest** (mesh axis sizes,
+  zero/dp/pipe/virtual-stage degrees, per-leaf partition specs, schedule
+  + stash config, global-batch shape) and a **data position** (exact
+  global sample offset), both in the human-readable tag manifest
+  (``manifest.json``) and in the pickled load metadata;
+- ``load_checkpoint(..., elastic=True)`` builds a :class:`ReshardPlan`
+  from the saved manifest against the LIVE engine: which axes reshard
+  (optimizer leaves re-partitioned along the new zero axis, pipeline
+  chunks remapped through ``PipelineParallelGrid.chunk_owner_stage``),
+  and which schedule features are DROPPED by the new topology (zb-stash
+  -> 1f1b, interleaved -> classic) — dropped features warn with the
+  repo's DISARMED discipline, naming exactly what was lost;
+- the data position lets a resumed run continue at the exact sample
+  offset (:func:`micro_batches_to_skip` / :func:`fast_forward`), so a
+  preempted run neither replays nor skips samples.
+
+Elastic config selection on resume reuses ``compute_elastic_config``
+(deepspeed_tpu/elasticity): a run restarted on a shrunken world keeps
+the SAME global batch with a re-derived micro-batch/gas pair, so the
+loss trajectory is unchanged — a placement-spec change in the sense of
+PAPERS.md 2601.02311, not a new training run.
+"""
+import logging
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+# manifest.json / metadata.pkl keys (shared with atomic.read_topology)
+TOPOLOGY_KEY = "topology"
+DATA_POSITION_KEY = "data_position"
+
+# schedule features lost when a checkpoint written under a richer
+# schedule resumes under a plainer one (the downgrade axis of an elastic
+# load); keys match runtime/pipe/schedule.py's schedule names
+_SCHEDULE_FEATURES = {
+    "1f1b": (),
+    "interleaved": ("virtual-stage interleaving",),
+    "zb-h1": ("zero-bubble wgrad deferral",),
+}
+
+
+class ElasticReshardError(RuntimeError):
+    """An elastic load that cannot be satisfied on the current mesh."""
+
+
+# ---------------------------------------------------------------------------
+# manifest construction (save side)
+# ---------------------------------------------------------------------------
+
+def partition_specs(engine):
+    """Per-leaf partition specs of the engine's live sharding tree, as
+    ``{tree_path: spec_string}`` — the zero-axis layout the writing mesh
+    used.  None before the state is built (saves always build first)."""
+    import jax
+
+    sh = getattr(engine, "_shardings", None)
+    if sh is None:
+        return None
+    out = {}
+    for p, s in jax.tree_util.tree_flatten_with_path(sh)[0]:
+        spec = getattr(s, "spec", s)
+        out[jax.tree_util.keystr(p)] = str(spec)
+    return out
+
+
+def topology_manifest(engine):
+    """The writing mesh's identity card, stored with every checkpoint.
+
+    Everything an elastic load needs to know what it is resharding FROM:
+    mesh axis sizes, parallel degrees, the zero partition layout, the
+    pipeline chunk grid + schedule/stash config, and the global-batch
+    shape that must be preserved across a resize."""
+    topo = {
+        "engine": type(engine).__name__,
+        "mesh": {str(a): int(s) for a, s in dict(engine.mesh.shape).items()},
+        "dp": int(engine.dp_world_size),
+        "mp": int(engine.mp_world_size),
+        "sp": int(engine.sp_world_size),
+        "zero_stage": int(engine.zero_optimization_stage()),
+        "global_batch": {
+            "train_batch_size": int(engine.train_batch_size()),
+            "micro_batch_per_gpu": int(engine.train_micro_batch_size_per_gpu()),
+            "gradient_accumulation_steps":
+                int(engine.gradient_accumulation_steps()),
+        },
+    }
+    if hasattr(engine, "num_stages"):  # PipelineEngine
+        from deepspeed_tpu.runtime.constants import (PIPELINE_STASH_BUDGET)
+
+        pipe = {
+            "num_stages": int(engine.num_stages),
+            "virtual_stages": int(engine.virtual_stages),
+            "num_chunks": int(engine.num_chunks),
+            "schedule": engine.pipe_schedule,
+            "requested_schedule": engine.requested_schedule,
+            "stash_armed": bool(engine._stash_armed),
+            "stash_budget": int(engine._config.pipeline[PIPELINE_STASH_BUDGET]),
+            "partition": [int(b) for b in
+                          engine.module.partition_layers(engine.num_chunks)],
+        }
+        pipe["chunk_owner_stage"] = [
+            int(engine.grid.chunk_owner_stage(q))
+            for q in range(engine.num_chunks)]
+        topo["pipe"] = pipe
+    else:
+        specs = partition_specs(engine)
+        if specs is not None:
+            topo["partition_specs"] = specs
+    return topo
+
+
+def data_position(engine):
+    """Exact position in the global sample stream: enough to fast-forward
+    ANY loader shape (the offset is in samples, not batches, so a resumed
+    run with a different micro-batch/dp split lands on the same sample)."""
+    mb = int(engine.train_micro_batch_size_per_gpu())
+    dp = int(engine.dp_world_size)
+    micro_steps = int(engine.micro_steps)
+    return {
+        "global_steps": int(engine.global_steps),
+        "micro_steps": micro_steps,
+        "micro_batch_per_gpu": mb,
+        "dp_world_size": dp,
+        "samples_consumed": micro_steps * mb * dp,
+    }
+
+
+# ---------------------------------------------------------------------------
+# data-order resume (load side)
+# ---------------------------------------------------------------------------
+
+def micro_batches_to_skip(position, engine):
+    """How many micro-batches of the CURRENT engine's shape cover the
+    saved sample offset.  Raises when the offset does not land on a
+    current micro-batch boundary — silently rounding would replay or
+    skip samples, the exact bug this exists to prevent.  With the global
+    batch preserved across the resize (compute_elastic_config), offsets
+    are always whole optimizer steps and therefore always divide."""
+    if position is None:
+        return 0
+    consumed = int(position.get("samples_consumed", 0))
+    per_batch = int(engine.train_micro_batch_size_per_gpu()) \
+        * int(engine.dp_world_size)
+    if consumed % per_batch:
+        raise ElasticReshardError(
+            f"checkpoint consumed {consumed} samples, which is not a "
+            f"multiple of the current micro_batch*dp = {per_batch} — the "
+            f"data stream cannot resume on a batch boundary. Use an "
+            f"elastic config (compute_elastic_config) so the global batch "
+            f"divides evenly at every world size")
+    return consumed // per_batch
+
+
+def fast_forward(data_iter, position, engine):
+    """Advance ``data_iter`` past the samples the checkpoint already
+    consumed; returns the iterator (same object) positioned at the next
+    unseen sample.  ``data_iter`` yields micro-batches of the CURRENT
+    shape (micro_batch*dp rows, the train_batch contract)."""
+    n = micro_batches_to_skip(position, engine)
+    for i in range(n):
+        try:
+            next(data_iter)
+        except StopIteration:
+            # raise-don't-misalign: a bare StopIteration would be eaten
+            # (or PEP 479-mangled) by generator-based training loops
+            raise ElasticReshardError(
+                f"data stream exhausted after {i} of {n} skip "
+                f"micro-batches — the loader is shorter than the "
+                f"checkpoint's {position['samples_consumed']}-sample "
+                f"offset; resume with the run's full (repeating) data "
+                f"stream") from None
+    if n:
+        log_dist(f"elastic resume: fast-forwarded data stream by {n} "
+                 f"micro-batches ({position['samples_consumed']} samples)",
+                 ranks=[0])
+    return data_iter
+
+
+# ---------------------------------------------------------------------------
+# pipeline chunk remapping
+# ---------------------------------------------------------------------------
+
+def chunk_layer_ranges(partition):
+    """[(lo, hi)) model-layer range per chunk from a partition boundary
+    list (module.partition_layers output, length num_chunks+1)."""
+    return [(int(partition[i]), int(partition[i + 1]))
+            for i in range(len(partition) - 1)]
+
+
+def chunk_remap(saved_pipe, grid, current_partition):
+    """Per-layer remap from the saved chunk grid onto the current one.
+
+    ``saved_pipe`` is the manifest's ``pipe`` section (num_stages,
+    virtual_stages, partition); ``grid`` the live PipelineParallelGrid;
+    ``current_partition`` the live module's chunk boundaries.  Returns a
+    list of ``{layer, saved_chunk, saved_stage, chunk, stage}`` — the
+    explicit statement that layer L, written by saved chunk q_s on saved
+    stage ``q_s % S_old``, is now owned by current chunk q on stage
+    ``grid.chunk_owner_stage(q)``.  Raises when the two grids do not
+    cover the same model."""
+    saved_ranges = chunk_layer_ranges(saved_pipe["partition"])
+    cur_ranges = chunk_layer_ranges(current_partition)
+    n_saved = saved_ranges[-1][1] if saved_ranges else 0
+    n_cur = cur_ranges[-1][1] if cur_ranges else 0
+    if n_saved != n_cur:
+        raise ElasticReshardError(
+            f"checkpoint partitions {n_saved} model layers but the current "
+            f"module has {n_cur} — elastic resharding remaps the same "
+            f"model across meshes, it cannot change the model")
+    saved_stages = int(saved_pipe["num_stages"])
+
+    def owner(ranges, layer):
+        for q, (lo, hi) in enumerate(ranges):
+            if lo <= layer < hi:
+                return q
+        raise ElasticReshardError(
+            f"layer {layer} not covered by chunk partition {ranges}")
+
+    remap = []
+    for layer in range(n_cur):
+        q_saved = owner(saved_ranges, layer)
+        q_cur = owner(cur_ranges, layer)
+        remap.append({
+            "layer": layer,
+            "saved_chunk": q_saved,
+            "saved_stage": q_saved % saved_stages,
+            "chunk": q_cur,
+            "stage": int(grid.chunk_owner_stage(q_cur)),
+        })
+    return remap
+
+
+# ---------------------------------------------------------------------------
+# elastic plan + reporting
+# ---------------------------------------------------------------------------
+
+def schedule_features(schedule, stash_armed=False):
+    """Human-readable feature set a (schedule, stash) pair provides."""
+    feats = list(_SCHEDULE_FEATURES.get(schedule, ()))
+    if stash_armed:
+        feats.append("bounded activation stashing")
+    return feats
+
+
+def plan_elastic_load(saved_topo, engine):
+    """Diff the saved topology manifest against the live engine.
+
+    Returns a plain dict (JSON-able, lands in the returned client_state):
+
+    - ``changed``: {axis: (saved, current)} for every differing degree;
+    - ``resharded``: human-readable actions the load performs (zero-axis
+      repartition, chunk remap, ...);
+    - ``dropped`` / ``gained``: schedule features lost/won by the move
+      (dropped features DISARM-warn in :func:`log_plan`);
+    - ``layers_moved``: pipeline layers whose owning stage changed;
+    - ``notes``: everything else worth surfacing.
+    """
+    plan = {"changed": {}, "resharded": [], "dropped": [], "gained": [],
+            "layers_moved": 0, "notes": []}
+    if saved_topo is None:
+        plan["notes"].append(
+            "checkpoint carries no topology manifest (pre-elastic "
+            "layout); resharding based on the live engine only")
+        return plan
+
+    for axis in ("dp", "mp", "sp", "zero_stage"):
+        saved = saved_topo.get(axis)
+        cur = {"dp": engine.dp_world_size, "mp": engine.mp_world_size,
+               "sp": engine.sp_world_size,
+               "zero_stage": engine.zero_optimization_stage()}[axis]
+        if saved is not None and int(saved) != int(cur):
+            plan["changed"][axis] = (int(saved), int(cur))
+    if "dp" in plan["changed"] or "zero_stage" in plan["changed"]:
+        s_dp = saved_topo.get("dp")
+        if int(saved_topo.get("zero_stage") or 0) > 0 \
+                or engine.zero_optimization_stage() > 0:
+            plan["resharded"].append(
+                f"optimizer-state leaves re-partitioned along the zero "
+                f"axis (dp {s_dp} -> {engine.dp_world_size}, zero stage "
+                f"{saved_topo.get('zero_stage')} -> "
+                f"{engine.zero_optimization_stage()})")
+        else:
+            plan["resharded"].append(
+                f"data-parallel degree changed (dp {s_dp} -> "
+                f"{engine.dp_world_size}); replicated state re-placed on "
+                f"the new mesh")
+
+    saved_pipe = saved_topo.get("pipe")
+    if saved_pipe is not None and hasattr(engine, "num_stages"):
+        cur_grid = (engine.num_stages, engine.virtual_stages)
+        saved_grid = (int(saved_pipe["num_stages"]),
+                      int(saved_pipe["virtual_stages"]))
+        if saved_grid[0] != cur_grid[0]:
+            plan["changed"]["pipe"] = (saved_grid[0], cur_grid[0])
+        if saved_grid[1] != cur_grid[1]:
+            plan["changed"]["virtual_stages"] = (saved_grid[1],
+                                                 cur_grid[1])
+        remap = chunk_remap(
+            saved_pipe, engine.grid,
+            engine.module.partition_layers(engine.num_chunks))
+        moved = sum(1 for r in remap if r["saved_stage"] != r["stage"])
+        plan["layers_moved"] = moved
+        if moved:
+            plan["resharded"].append(
+                f"{moved}/{len(remap)} pipeline layers remapped to new "
+                f"owner stages through chunk_owner_stage "
+                f"({saved_grid[0]}x{saved_grid[1]} -> "
+                f"{cur_grid[0]}x{cur_grid[1]} chunk grid)")
+        saved_feats = set(schedule_features(
+            saved_pipe.get("schedule"), saved_pipe.get("stash_armed")))
+        cur_feats = set(schedule_features(
+            engine.pipe_schedule, engine._stash_armed))
+        plan["dropped"] = sorted(saved_feats - cur_feats)
+        plan["gained"] = sorted(cur_feats - saved_feats)
+        if plan["dropped"] or plan["gained"]:
+            plan["notes"].append(
+                f"schedule {saved_pipe.get('schedule')}"
+                f"{' + stash' if saved_pipe.get('stash_armed') else ''}"
+                f" -> {engine.pipe_schedule}"
+                f"{' + stash' if engine._stash_armed else ''}")
+    elif saved_pipe is not None:
+        plan["notes"].append(
+            "checkpoint was written by a PipelineEngine; loading on the "
+            "base engine ignores its chunk grid (layer files are "
+            "stage-independent)")
+
+    saved_gb = (saved_topo.get("global_batch") or {}).get("train_batch_size")
+    if saved_gb is not None:
+        if int(saved_gb) == int(engine.train_batch_size()):
+            if plan["changed"]:
+                plan["notes"].append(
+                    f"global batch preserved at {saved_gb} "
+                    f"(micro/gas re-derived for the new world)")
+        else:
+            plan["notes"].append(
+                f"GLOBAL BATCH CHANGED: {saved_gb} -> "
+                f"{engine.train_batch_size()} — the loss trajectory will "
+                f"diverge from the original run; use an elasticity config "
+                f"so compute_elastic_config preserves it across resizes")
+    return plan
+
+
+def log_plan(plan):
+    """Surface a reshard plan: resharding actions as info, dropped
+    schedule features as a DISARMED warning naming exactly what was
+    lost (the repo's armed-or-warns discipline)."""
+    for line in plan["resharded"]:
+        log_dist(f"elastic resume: {line}", ranks=[0])
+    if plan["dropped"]:
+        log_dist(
+            f"elastic resume: schedule features DISARMED by the new "
+            f"topology — dropped: {', '.join(plan['dropped'])}"
+            + (f" ({'; '.join(plan['notes'])})" if plan["notes"] else ""),
+            ranks=[0], level=logging.WARNING)
+    if plan["gained"]:
+        log_dist(f"elastic resume: schedule features gained: "
+                 f"{', '.join(plan['gained'])}", ranks=[0])
+    for note in plan["notes"]:
+        if "GLOBAL BATCH CHANGED" in note:
+            log_dist(f"elastic resume: {note}", ranks=[0],
+                     level=logging.WARNING)
+        elif not plan["dropped"]:
+            log_dist(f"elastic resume: {note}", ranks=[0])
+
+
+def elastic_batch_check(engine):
+    """Consult compute_elastic_config for the CURRENT world and confirm
+    the config's batch shape matches (the config computed it at init when
+    elasticity is enabled).  Returns ``(final_batch, micro, gas)`` or
+    None when no elasticity config is present."""
+    pd = engine._config._param_dict
+    from deepspeed_tpu.elasticity import (compute_elastic_config,
+                                          elasticity_enabled)
+
+    if not elasticity_enabled(pd):
+        return None
+    from deepspeed_tpu.version import __version__
+
+    final, _valid, micro = compute_elastic_config(
+        pd, __version__, world_size=int(engine.dp_world_size))
+    gas = final // (micro * int(engine.dp_world_size))
+    if (final, micro, gas) != (engine.train_batch_size(),
+                               engine.train_micro_batch_size_per_gpu(),
+                               engine.gradient_accumulation_steps()):
+        raise ElasticReshardError(
+            f"elastic config resolves to (batch={final}, micro={micro}, "
+            f"gas={gas}) at world size {engine.dp_world_size} but the "
+            f"engine is configured with "
+            f"(batch={engine.train_batch_size()}, "
+            f"micro={engine.train_micro_batch_size_per_gpu()}, "
+            f"gas={engine.gradient_accumulation_steps()}) — the elastic "
+            f"config is immutable once scheduled "
+            f"(ensure_immutable_elastic_config)")
+    return final, micro, gas
+
+
+def elastic_load_report(meta, engine):
+    """The load-side entry point both engines call under
+    ``load_checkpoint(..., elastic=True)``: plan the reshard from the
+    checkpoint metadata, log it (DISARMED warnings included), verify the
+    elastic batch config, and return the JSON-able report that joins the
+    returned client_state."""
+    plan = plan_elastic_load(meta.get(TOPOLOGY_KEY), engine)
+    log_plan(plan)
+    resolved = elastic_batch_check(engine)
+    if resolved is not None:
+        plan["elastic_config"] = {
+            "train_batch_size": int(resolved[0]),
+            "micro_batch_per_gpu": int(resolved[1]),
+            "gradient_accumulation_steps": int(resolved[2]),
+        }
+    position = meta.get(DATA_POSITION_KEY)
+    if position is not None:
+        plan[DATA_POSITION_KEY] = dict(position)
+        try:
+            plan["micro_batches_to_skip"] = micro_batches_to_skip(position,
+                                                                  engine)
+        except ElasticReshardError as e:
+            # the STATE restore is still valid; only the exact-sample
+            # data resume is not — surface it without failing the load
+            # (auto-resume falling back to an older tag would not help:
+            # the misalignment is a property of the new batch shape)
+            plan["data_position_error"] = str(e)
+            logger.warning(f"elastic resume: {e}")
+    return plan

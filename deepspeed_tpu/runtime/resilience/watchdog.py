@@ -43,6 +43,20 @@ class WatchdogAlarm(RuntimeError):
         self.event = event
 
 
+class GracefulPreemption(RuntimeError):
+    """Raised out of the training loop AFTER a coordinated emergency
+    checkpoint committed (or was skipped with a warning) in response to
+    a preemption signal — engine.request_preemption(), an installed
+    SIGTERM handler, or a chaos ``preempt_after_steps`` plan.  Catching
+    it and exiting 0 is the expected shutdown path on preemptible pods;
+    the run resumes elastically via load_checkpoint(auto_resume=True)."""
+
+    def __init__(self, message, tag=None, save_dir=None):
+        super().__init__(message)
+        self.tag = tag
+        self.save_dir = save_dir
+
+
 class TrainingWatchdog:
     """Streak/stall detector.  Thresholds of 0 disable that detector."""
 

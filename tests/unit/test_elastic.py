@@ -111,3 +111,113 @@ def test_get_valid_gpus():
     # world w valid iff 24/(mb) divisible by w for mb in {2,3}: 12's divisors + 8's divisors
     expected = sorted(set([1, 2, 3, 4, 6, 12]) | set([1, 2, 4, 8]))
     assert valid == expected
+
+
+# ---------------------------------------------------------------------------
+# edge cases (ISSUE 7 satellite): prime worlds, micro-batch bounds,
+# version-compat paths, immutable scheduled config
+# ---------------------------------------------------------------------------
+
+def _mini_config(micro_batches, max_batch, **over):
+    cfg = {"enabled": True, "max_train_batch_size": max_batch,
+           "micro_batch_sizes": micro_batches, "min_gpus": 1,
+           "max_gpus": 1500, "min_time": 20, "version": 0.1}
+    cfg.update(over)
+    return {"elasticity": cfg}
+
+
+def test_prime_world_size_valid_when_micro_batch_matches():
+    """A prime world size is only reachable through a micro batch that
+    carries the prime factor."""
+    ds = _mini_config([4, 11], 44)
+    final, valid, mb = compute_elastic_config(
+        ds_config=ds, target_deepspeed_version="0.3.11", world_size=11)
+    assert final == 44 and 11 in valid
+    assert final % (mb * 11) == 0
+
+
+def test_prime_world_size_invalid_without_factor():
+    """micro batches {2, 4} can never serve 13 chips: no candidate batch
+    divides into 13 equal per-replica shares."""
+    ds = _mini_config([2, 4], 64)
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(ds_config=ds,
+                               target_deepspeed_version="0.3.11",
+                               world_size=13)
+
+
+def test_micro_batch_above_max_batch_rejected():
+    """Reference quirk guard: a micro batch larger than the max acceptable
+    batch can never be scheduled — the v0.1 solver asserts on it."""
+    ds = _mini_config([64], 32)
+    with pytest.raises(AssertionError, match="max_acceptable_batch_size"):
+        compute_elastic_config(ds_config=ds,
+                               target_deepspeed_version="0.3.11")
+
+
+def test_micro_batch_values_validated():
+    for bad in ([0], [-2], [2.5], ["4"], "not-a-list"):
+        ds = _mini_config(bad, 32)
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(ds_config=ds,
+                                   target_deepspeed_version="0.3.11")
+
+
+def test_gpu_range_validated():
+    ds = _mini_config([2], 32, min_gpus=8, max_gpus=4)
+    with pytest.raises(ElasticityConfigError, match="Invalid gpu range"):
+        compute_elastic_config(ds_config=ds,
+                               target_deepspeed_version="0.3.11")
+    ds = _mini_config([2], 32, min_gpus=0)
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(ds_config=ds,
+                               target_deepspeed_version="0.3.11")
+
+
+def test_version_below_minimum_rejected():
+    from deepspeed_tpu.elasticity.config import ElasticityError
+    from deepspeed_tpu.elasticity.elasticity import _compatible_version_check
+
+    with pytest.raises(ElasticityError, match="below the minimum"):
+        _compatible_version_check("0.0.9")
+
+
+def test_version_exactly_minimum_and_above_accepted():
+    from deepspeed_tpu.elasticity.constants import MINIMUM_DEEPSPEED_VERSION
+    from deepspeed_tpu.elasticity.elasticity import _compatible_version_check
+
+    assert _compatible_version_check(MINIMUM_DEEPSPEED_VERSION)
+    assert _compatible_version_check("999.0")
+    # patchless versions parse as .0
+    assert _compatible_version_check("0.1")
+
+
+def test_version_unparseable_rejected():
+    from deepspeed_tpu.elasticity.elasticity import _compatible_version_check
+
+    with pytest.raises(ElasticityConfigError, match="Unable to parse"):
+        _compatible_version_check("not-a-version")
+
+
+def test_immutable_elastic_config_violation(monkeypatch):
+    """The scheduler stashes the elastic config in the environment; a
+    runtime config that drifted from it must be rejected."""
+    import json
+
+    from deepspeed_tpu.elasticity import ensure_immutable_elastic_config
+
+    scheduled = _mini_config([2, 4], 32)["elasticity"]
+    monkeypatch.setenv("DEEPSPEED_ELASTICITY_CONFIG", json.dumps(scheduled))
+    # identical config passes
+    ensure_immutable_elastic_config(dict(scheduled))
+    # any drift (here: max batch) is a violation
+    drifted = dict(scheduled, max_train_batch_size=64)
+    with pytest.raises(ElasticityConfigError, match="immutable"):
+        ensure_immutable_elastic_config(drifted)
+
+
+def test_immutable_elastic_config_no_env_is_noop(monkeypatch):
+    from deepspeed_tpu.elasticity import ensure_immutable_elastic_config
+
+    monkeypatch.delenv("DEEPSPEED_ELASTICITY_CONFIG", raising=False)
+    ensure_immutable_elastic_config({"anything": True})

@@ -1,0 +1,661 @@
+"""Topology-elastic checkpoints (ISSUE 7): save once, resume on any mesh,
+survive preemption.
+
+Acceptance guards:
+
+- **Round-trip**: save under (dp=4), (dp=2, pipe=2), and (pipe=4 zb-h1 +
+  activation stashing); load each under several OTHER topologies — every
+  state leaf bit-exact against the source checkpoint AND against a
+  re-save from the target mesh, and 3 post-resume steps produce losses
+  bit-identical (fp32) to the uninterrupted source run at the same
+  global batch.
+- **Preemption grace**: a chaos graceful-preempt lands a committed
+  ``preempt_step<N>`` tag; restart on HALF the devices auto-resumes via
+  the elastic config with the global batch preserved and the data stream
+  fast-forwarded to the exact sample offset; a hard kill landing
+  mid-preempt-save still falls back to the last committed tag.
+"""
+import logging
+import os
+import pickle
+import types
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.resilience import chaos, reshard
+from deepspeed_tpu.runtime.resilience.atomic import (is_preempt_tag,
+                                                     load_manifest,
+                                                     read_latest,
+                                                     read_topology,
+                                                     select_resume_tag,
+                                                     verify_tag)
+from deepspeed_tpu.runtime.resilience.chaos import ChaosInterrupt
+from deepspeed_tpu.runtime.resilience.reshard import (ElasticReshardError,
+                                                      chunk_layer_ranges,
+                                                      chunk_remap,
+                                                      fast_forward,
+                                                      micro_batches_to_skip)
+from deepspeed_tpu.runtime.resilience.watchdog import (GracefulPreemption,
+                                                       WatchdogAlarm)
+from tests.unit.simple_model import (SimpleModel, make_stack_specs,
+                                     random_dataloader)
+
+HIDDEN = 16
+PIPE_HIDDEN = 8
+N_LAYERS = 7   # 7 Dense + 1 Head = 8 specs: divides every chunk grid used
+MICRO = 2
+GLOBAL_BATCH = 16
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    chaos.disarm()
+
+
+# ---------------------------------------------------------------------------
+# reshard unit layer (no engine)
+# ---------------------------------------------------------------------------
+
+def _grid(pipe, v=1):
+    from deepspeed_tpu.runtime.pipe.topology import (PipeDataParallelTopology,
+                                                     PipelineParallelGrid)
+
+    return PipelineParallelGrid(
+        topology=PipeDataParallelTopology(num_pp=pipe, num_dp=1),
+        rank=0, virtual_stages=v)
+
+
+def test_chunk_layer_ranges():
+    assert chunk_layer_ranges([0, 2, 4, 6, 8]) == [(0, 2), (2, 4), (4, 6),
+                                                   (6, 8)]
+
+
+def test_chunk_remap_4x1_to_2x2():
+    """The same 4-chunk partition read back on a pipe=2, v=2 grid: chunk
+    indices survive, owner stages fold through chunk_owner_stage."""
+    saved = {"num_stages": 4, "virtual_stages": 1,
+             "partition": [0, 2, 4, 6, 8]}
+    remap = chunk_remap(saved, _grid(2, v=2), [0, 2, 4, 6, 8])
+    assert len(remap) == 8
+    # layer 4 sat in saved chunk 2 on stage 2; now chunk 2 on stage 0
+    r4 = remap[4]
+    assert (r4["saved_chunk"], r4["saved_stage"]) == (2, 2)
+    assert (r4["chunk"], r4["stage"]) == (2, 0)
+    # layer 0 never moves: chunk 0 owned by stage 0 in both grids
+    assert remap[0]["saved_stage"] == remap[0]["stage"] == 0
+
+
+def test_chunk_remap_2_to_4_repartition():
+    saved = {"num_stages": 2, "virtual_stages": 1, "partition": [0, 4, 8]}
+    remap = chunk_remap(saved, _grid(4), [0, 2, 4, 6, 8])
+    moved = [r for r in remap if r["saved_stage"] != r["stage"]]
+    # layers 2,3 (stage 0 -> 1), 4,5 (1 -> 2), 6,7 (1 -> 3) move
+    assert len(moved) == 6
+
+
+def test_chunk_remap_rejects_different_model():
+    saved = {"num_stages": 2, "virtual_stages": 1, "partition": [0, 4, 8]}
+    with pytest.raises(ElasticReshardError, match="cannot change the model"):
+        chunk_remap(saved, _grid(2), [0, 3, 6])
+
+
+def _fake_engine(micro, dp):
+    return types.SimpleNamespace(
+        train_micro_batch_size_per_gpu=lambda: micro,
+        dp_world_size=dp)
+
+
+def test_micro_batches_to_skip_arithmetic():
+    pos = {"samples_consumed": 48}
+    assert micro_batches_to_skip(pos, _fake_engine(2, 4)) == 6
+    assert micro_batches_to_skip(pos, _fake_engine(4, 2)) == 6
+    assert micro_batches_to_skip(pos, _fake_engine(2, 2)) == 12
+    assert micro_batches_to_skip(None, _fake_engine(2, 2)) == 0
+    assert micro_batches_to_skip({"samples_consumed": 0},
+                                 _fake_engine(2, 2)) == 0
+
+
+def test_micro_batches_to_skip_rejects_misaligned_offset():
+    """Rounding would replay or drop samples — refuse loudly instead."""
+    with pytest.raises(ElasticReshardError, match="batch boundary"):
+        micro_batches_to_skip({"samples_consumed": 50}, _fake_engine(4, 3))
+
+
+def test_fast_forward_lands_on_exact_sample():
+    def gen():
+        i = 0
+        while True:
+            yield list(range(i * 4, (i + 1) * 4))
+            i += 1
+
+    it = iter(gen())
+    out = fast_forward(it, {"samples_consumed": 24}, _fake_engine(2, 2))
+    first = next(out)
+    assert first[0] == 24, first
+
+
+# ---------------------------------------------------------------------------
+# engine helpers
+# ---------------------------------------------------------------------------
+
+def base_engine(dp, micro, gas, stage=2):
+    cfg = {
+        "train_batch_size": micro * gas * dp,
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 100,
+        "zero_optimization": {"stage": stage},
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "mesh": {"data": dp, "allow_partial": True},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(HIDDEN), config_params=cfg)
+    return engine
+
+
+def pipe_engine(pipe, dp, micro, gas, schedule=None, virtual_stages=1):
+    specs, loss_fn, input_fn = make_stack_specs(PIPE_HIDDEN, N_LAYERS)
+    module = deepspeed_tpu.PipelineModule(
+        specs, loss_fn=loss_fn, input_fn=input_fn)
+    cfg = {
+        "train_batch_size": micro * gas * dp,
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 100,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "mesh": {"pipe": pipe, "data": dp, "model": 1,
+                 "allow_partial": True},
+    }
+    if schedule:
+        cfg["pipeline"] = {"schedule": schedule,
+                           "virtual_stages": virtual_stages}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=module,
+                                               config_params=cfg)
+    return engine
+
+
+def npz_leaves(path):
+    """All named arrays of one npz file (the bit-exactness unit)."""
+    with np.load(path) as data:
+        return {k: np.array(data[k]) for k in data.files}
+
+
+def assert_ckpt_payload_equal(dir_a, tag_a, dir_b, tag_b):
+    """Every npz payload entry of two tags bit-identical (metadata.pkl is
+    excluded: it legitimately records the differing topologies)."""
+    a_dir, b_dir = os.path.join(dir_a, tag_a), os.path.join(dir_b, tag_b)
+    a_files = sorted(f for f in os.listdir(a_dir) if f.endswith(".npz"))
+    b_files = sorted(f for f in os.listdir(b_dir) if f.endswith(".npz"))
+    assert a_files == b_files
+    for name in a_files:
+        la = npz_leaves(os.path.join(a_dir, name))
+        lb = npz_leaves(os.path.join(b_dir, name))
+        assert set(la) == set(lb), name
+        for k in la:
+            assert la[k].dtype == lb[k].dtype, (name, k)
+            assert la[k].shape == lb[k].shape, (name, k)
+            assert la[k].tobytes() == lb[k].tobytes(), f"{name}:{k}"
+
+
+def losses_of(engine, it, n):
+    return [float(jax.device_get(engine.train_batch(data_iter=it)))
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# topology manifest on disk
+# ---------------------------------------------------------------------------
+
+def test_manifest_carries_topology_and_data_position(tmp_path):
+    e = base_engine(dp=2, micro=2, gas=2)
+    it = random_dataloader(HIDDEN, 64, 4)
+    for _ in range(3):
+        e.train_batch(data_iter=it)
+    e.save_checkpoint(str(tmp_path), backend="npz")
+    manifest = load_manifest(str(tmp_path / "global_step3"))
+    topo = manifest["topology"]
+    assert topo["dp"] == 2 and topo["zero_stage"] == 2
+    assert topo["mesh"] == {"pipe": 1, "data": 2, "seq": 1, "model": 1}
+    assert topo["global_batch"]["train_batch_size"] == 8
+    assert topo["partition_specs"]  # per-leaf zero-axis layout recorded
+    pos = manifest["data_position"]
+    assert pos["samples_consumed"] == 3 * 2 * 2 * 2  # steps*gas*micro*dp
+    # tooling access without unpickling
+    assert read_topology(str(tmp_path / "global_step3"))["dp"] == 2
+    assert not is_preempt_tag(str(tmp_path), "global_step3")
+    # the pickled load metadata carries the same keys
+    with open(tmp_path / "global_step3" / "metadata.pkl", "rb") as f:
+        meta = pickle.load(f)
+    assert meta["topology"]["dp"] == 2
+    assert meta["data_position"] == pos
+
+
+def test_pipe_manifest_records_chunk_grid(tmp_path):
+    e = pipe_engine(pipe=2, dp=2, micro=MICRO, gas=4)
+    it = random_dataloader(PIPE_HIDDEN, 64, MICRO * 2)
+    e.train_batch(data_iter=it)
+    e.save_checkpoint(str(tmp_path), tag="t")
+    topo = read_topology(str(tmp_path / "t"))
+    pipe = topo["pipe"]
+    assert pipe["num_stages"] == 2 and pipe["virtual_stages"] == 1
+    assert pipe["schedule"] == "1f1b"
+    assert pipe["partition"][0] == 0 and pipe["partition"][-1] == 8
+    assert pipe["chunk_owner_stage"] == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# round-trip guard: base engine, save at dp=4 -> 3 other topologies
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def base_src(tmp_path_factory):
+    """dp=4 zero-2 source: 2 steps, save, then 3 UNINTERRUPTED steps whose
+    fp32 losses are the bit-exactness reference for every resumed run."""
+    d = str(tmp_path_factory.mktemp("base_src"))
+    e = base_engine(dp=4, micro=2, gas=2)
+    it_a = random_dataloader(HIDDEN, 64, 8, seed=0)
+    for _ in range(2):
+        e.train_batch(data_iter=it_a)
+    e.save_checkpoint(d, tag="src", backend="npz")
+    it_b = random_dataloader(HIDDEN, 64, 8, seed=123)
+    ref_losses = losses_of(e, it_b, 3)
+    return d, ref_losses
+
+
+@pytest.mark.parametrize("dp,micro,gas,exact", [
+    (2, 2, 4, True),    # half the chips (the preemption direction)
+    (8, 2, 1, False),   # double the chips: gas 2->1 merges two 8-row
+                        # micro-means into one 16-row mean — same value,
+                        # reassociated floating-point sum (ulp-level)
+    (1, 4, 4, True),    # single chip
+])
+def test_base_roundtrip_other_topology(base_src, tmp_path, dp, micro, gas,
+                                       exact):
+    """Same global batch (16) on a different mesh: leaves bit-exact vs the
+    source checkpoint AND vs a re-save from the target mesh; 3 resumed
+    steps bit-identical (fp32) to the uninterrupted run wherever the
+    micro/gas split preserves the reduction tree (every shrink here)."""
+    src_dir, ref_losses = base_src
+    e = base_engine(dp=dp, micro=micro, gas=gas)
+    it = random_dataloader(HIDDEN, 64, micro * dp, seed=9)
+    e.init_from_batch(next(it))
+    path, client = e.load_checkpoint(src_dir, tag="src", elastic=True)
+    assert path is not None
+    report = client["elastic_reshard"]
+    assert report["changed"].get("dp") == (4, dp)
+    assert client["data_position"]["samples_consumed"] == 32
+    # every state leaf bit-exact vs what the source mesh wrote
+    from deepspeed_tpu.runtime.checkpoint_utils import npz_dict_to_leaves
+
+    with np.load(os.path.join(src_dir, "src", "model_states.npz")) as data:
+        src_leaves = npz_dict_to_leaves(data)
+    cur_leaves = [np.asarray(jax.device_get(l))
+                  for l in jax.tree_util.tree_leaves(e.state)]
+    assert len(src_leaves) == len(cur_leaves)
+    for a, b in zip(src_leaves, cur_leaves):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert a.tobytes() == b.tobytes()
+    # a direct save from the target mesh is payload-identical
+    e.save_checkpoint(str(tmp_path), tag="resaved", backend="npz")
+    assert_ckpt_payload_equal(src_dir, "src", str(tmp_path), "resaved")
+    # 3 post-resume steps: bit-identical fp32 losses at the same global batch
+    it_b = random_dataloader(HIDDEN, 64, micro * dp, seed=123)
+    got = losses_of(e, it_b, 3)
+    if exact:
+        assert got == ref_losses, (got, ref_losses)
+    else:
+        np.testing.assert_allclose(got, ref_losses, rtol=1e-6)
+
+
+def test_misaligned_offset_reported_not_fatal(base_src, caplog):
+    """A new batch shape that cannot land on the saved sample offset must
+    still load the STATE (auto-resume falling back to older tags would
+    not fix a property of the new shape) — the exact-sample resume error
+    is reported in the plan instead."""
+    src_dir, _ = base_src
+    e = base_engine(dp=2, micro=3, gas=2)  # micro*dp=6 does not divide 32
+    it = random_dataloader(HIDDEN, 64, 6, seed=9)
+    e.init_from_batch(next(it))
+    path, client = e.load_checkpoint(src_dir, tag="src", elastic=True)
+    assert path is not None and e.global_steps == 2
+    report = client["elastic_reshard"]
+    assert "micro_batches_to_skip" not in report
+    assert "batch boundary" in report["data_position_error"]
+    with pytest.raises(ElasticReshardError):
+        fast_forward(it, client["data_position"], e)
+
+
+# ---------------------------------------------------------------------------
+# round-trip guard: pipeline engine
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def pipe_src(tmp_path_factory):
+    """(dp=2, pipe=2) source with the same uninterrupted-reference shape."""
+    d = str(tmp_path_factory.mktemp("pipe_src"))
+    e = pipe_engine(pipe=2, dp=2, micro=MICRO, gas=4)
+    it_a = random_dataloader(PIPE_HIDDEN, 64, MICRO * 2, seed=0)
+    for _ in range(2):
+        e.train_batch(data_iter=it_a)
+    e.save_checkpoint(d, tag="src")
+    it_b = random_dataloader(PIPE_HIDDEN, 64, MICRO * 2, seed=123)
+    ref_losses = losses_of(e, it_b, 3)
+    return d, ref_losses
+
+
+@pytest.mark.parametrize("pipe,dp,gas,schedule,v", [
+    (4, 2, 4, None, 1),            # deeper pipeline
+    (2, 4, 2, None, 1),            # chips moved from pipe to data
+    (4, 2, 4, "interleaved", 2),   # virtual-stage upgrade
+])
+def test_pipe_roundtrip_other_topology(pipe_src, tmp_path, pipe, dp, gas,
+                                       schedule, v):
+    src_dir, ref_losses = pipe_src
+    e = pipe_engine(pipe=pipe, dp=dp, micro=MICRO, gas=gas,
+                    schedule=schedule, virtual_stages=v)
+    # prime with DIFFERENT data so the load must overwrite everything
+    it = random_dataloader(PIPE_HIDDEN, 64, MICRO * dp, seed=7)
+    e.train_batch(data_iter=it)
+    path, client = e.load_checkpoint(src_dir, tag="src", elastic=True)
+    assert path is not None
+    if schedule == "interleaved":
+        assert e.pipe_schedule == "interleaved"  # upgrade actually armed
+    assert client["data_position"]["samples_consumed"] == 32
+    # chunk remap flows through chunk_owner_stage; a re-save from the new
+    # grid produces the identical layer-keyed payload
+    e.save_checkpoint(str(tmp_path), tag="resaved")
+    assert_ckpt_payload_equal(src_dir, "src", str(tmp_path), "resaved")
+    it_b = random_dataloader(PIPE_HIDDEN, 64, MICRO * dp, seed=123)
+    got = losses_of(e, it_b, 3)
+    assert got == ref_losses, (got, ref_losses)
+
+
+def test_pipe_zb_stash_downgrade_roundtrip(tmp_path, caplog):
+    """Save under zb-h1 + activation stashing (pipe=4), resume under plain
+    1f1b (pipe=2): payload identical, trajectory identical, and the
+    dropped schedule features warn DISARMED by name."""
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    e1 = pipe_engine(pipe=4, dp=2, micro=MICRO, gas=4, schedule="zb-h1")
+    it_a = random_dataloader(PIPE_HIDDEN, 64, MICRO * 2, seed=0)
+    for _ in range(2):
+        e1.train_batch(data_iter=it_a)
+    assert e1.pipe_schedule == "zb-h1" and e1._stash_armed
+    e1.save_checkpoint(str(tmp_path), tag="zb")
+    topo = read_topology(str(tmp_path / "zb"))
+    assert topo["pipe"]["schedule"] == "zb-h1"
+    assert topo["pipe"]["stash_armed"] is True
+
+    e2 = pipe_engine(pipe=2, dp=2, micro=MICRO, gas=4)
+    it = random_dataloader(PIPE_HIDDEN, 64, MICRO * 2, seed=7)
+    e2.train_batch(data_iter=it)
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            path, client = e2.load_checkpoint(str(tmp_path), tag="zb",
+                                              elastic=True)
+    finally:
+        ds_logger.propagate = False
+    report = client["elastic_reshard"]
+    assert "zero-bubble wgrad deferral" in report["dropped"]
+    assert "bounded activation stashing" in report["dropped"]
+    assert report["layers_moved"] > 0
+    disarmed = [r.message for r in caplog.records if "DISARMED" in r.message]
+    assert disarmed and "wgrad deferral" in disarmed[-1] \
+        and "stashing" in disarmed[-1]
+    # trajectory: the downgraded engine continues bit-for-bit with the
+    # uninterrupted zb run (one forward per micro in both worlds)
+    d1 = random_dataloader(PIPE_HIDDEN, 64, MICRO * 2, seed=123)
+    d2 = random_dataloader(PIPE_HIDDEN, 64, MICRO * 2, seed=123)
+    l1 = losses_of(e1, d1, 3)
+    l2 = losses_of(e2, d2, 3)
+    assert l1 == l2, (l1, l2)
+
+
+# ---------------------------------------------------------------------------
+# preemption grace
+# ---------------------------------------------------------------------------
+
+ELASTIC_BLOCK = {
+    "enabled": True,
+    "max_train_batch_size": GLOBAL_BATCH,
+    "micro_batch_sizes": [2, 4],
+    "min_gpus": 1,
+    "max_gpus": 8,
+    "version": 0.1,
+}
+
+
+def elastic_engine(dp):
+    cfg = {
+        "steps_per_print": 100,
+        "elasticity": dict(ELASTIC_BLOCK),
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "mesh": {"data": dp, "allow_partial": True},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(HIDDEN), config_params=cfg)
+    return engine
+
+
+def test_preempt_lands_committed_tag_and_resumes_on_half_mesh(tmp_path):
+    """The tentpole's end-to-end chaos test: graceful preempt at dp=4,
+    restart at dp=2 auto-resumes from the preempt tag via the elastic
+    config with the global batch preserved and the data stream
+    fast-forwarded to the exact sample offset."""
+    interrupted = {}
+
+    def run():
+        e = elastic_engine(dp=4)
+        # elastic config resolves (batch=16, micro=4, gas=1) at world 4
+        assert e.train_batch_size() == GLOBAL_BATCH
+        it = random_dataloader(HIDDEN, 64,
+                               e.train_micro_batch_size_per_gpu() * 4,
+                               seed=0)
+        for _ in range(2):
+            e.train_batch(data_iter=it)
+        e.save_checkpoint(str(tmp_path), backend="npz")
+        interrupted["engine"] = e
+        for _ in range(10):
+            e.train_batch(data_iter=it)
+
+    def resume():
+        e2 = elastic_engine(dp=2)
+        assert e2.train_batch_size() == GLOBAL_BATCH  # preserved
+        it = random_dataloader(HIDDEN, 64,
+                               e2.train_micro_batch_size_per_gpu() * 2,
+                               seed=0)
+        e2.init_from_batch(next(it))
+        path, client = e2.load_checkpoint(str(tmp_path), auto_resume=True)
+        return e2, path, client
+
+    # 4 = the 2 warm-up steps before the save + 2 more: the plan arms
+    # before run() starts, and every optimizer step consumes budget
+    (e2, path, client), interrupt = chaos.preempt_then_resume(
+        run, resume, preempt_after_steps=4)
+    assert isinstance(interrupt, GracefulPreemption)
+    assert interrupt.tag == "preempt_step4"
+    # committed + latest-updated (healthy state, unlike emergency tags)
+    assert read_latest(str(tmp_path)) == "preempt_step4"
+    assert is_preempt_tag(str(tmp_path), "preempt_step4")
+    ok, reason = verify_tag(str(tmp_path / "preempt_step4"))
+    assert ok, reason
+    # resume landed on it, on half the devices
+    assert path.endswith("preempt_step4")
+    assert e2.global_steps == 4
+    report = client["elastic_reshard"]
+    assert report["elastic_config"]["train_batch_size"] == GLOBAL_BATCH
+    # exact sample offset: 4 steps * 16-sample global batches
+    assert client["data_position"]["samples_consumed"] == 64
+    assert report["micro_batches_to_skip"] == 64 // (4 * 2)
+    # and the resumed trajectory continues finitely
+    it = random_dataloader(HIDDEN, 64, 8, seed=123)
+    assert np.isfinite(losses_of(e2, it, 2)).all()
+
+
+def test_hard_kill_mid_preempt_falls_back_to_committed(tmp_path):
+    """A hard kill landing inside the preempt save must not strand the
+    restart: the torn tag is invisible, the last committed tag wins."""
+    # the healthy save happens BEFORE chaos arms: kill_at_point would
+    # otherwise kill the warm-up commit instead of the preempt save
+    e = elastic_engine(dp=4)
+    it = random_dataloader(HIDDEN, 64, 16, seed=0)
+    for _ in range(2):
+        e.train_batch(data_iter=it)
+    e.save_checkpoint(str(tmp_path), backend="npz")
+
+    def run():
+        for _ in range(10):
+            e.train_batch(data_iter=it)
+
+    def resume():
+        e2 = elastic_engine(dp=2)
+        it2 = random_dataloader(HIDDEN, 64, 8, seed=0)
+        e2.init_from_batch(next(it2))
+        return e2.load_checkpoint(str(tmp_path), auto_resume=True)
+
+    (path, client), interrupt = chaos.preempt_then_resume(
+        run, resume, preempt_after_steps=1, kill_at_point="before_rename")
+    assert isinstance(interrupt, ChaosInterrupt)
+    # the preempt tag never became visible; resume = last committed save
+    assert read_latest(str(tmp_path)) == "global_step2"
+    assert select_resume_tag(str(tmp_path)) == "global_step2"
+    assert path.endswith("global_step2")
+    assert client["data_position"] is None or \
+        client["data_position"]["global_steps"] == 2
+
+
+def test_request_preemption_api(tmp_path):
+    """The production entry point (SIGTERM handler target): flag now,
+    save + raise at the next step boundary."""
+    e = base_engine(dp=2, micro=2, gas=2)
+    it = random_dataloader(HIDDEN, 64, 4, seed=0)
+    e.train_batch(data_iter=it)
+    e.save_checkpoint(str(tmp_path), backend="npz")
+    e.request_preemption()
+    with pytest.raises(GracefulPreemption) as ei:
+        e.train_batch(data_iter=it)
+    assert ei.value.tag == "preempt_step2"
+    assert read_latest(str(tmp_path)) == "preempt_step2"
+    meta_path = tmp_path / "preempt_step2" / "metadata.pkl"
+    with open(meta_path, "rb") as f:
+        meta = pickle.load(f)
+    assert meta["client_state"]["data_position"]["samples_consumed"] == 16
+
+
+def test_preempt_prefers_run_ckpt_dir_over_emergency_dir(tmp_path):
+    """The preempt tag holds healthy state and moves ``latest`` — it must
+    land where restarts look (the run's own checkpoint dir), NOT in the
+    watchdog's postmortem emergency dir."""
+    emer = tmp_path / "emergency"
+    ckpts = tmp_path / "ckpts"
+    cfg = {
+        "train_batch_size": 8,
+        "steps_per_print": 100,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "mesh": {"data": 2, "allow_partial": True},
+        "resilience": {"watchdog": {"enabled": True,
+                                    "max_skipped_steps": 20,
+                                    "emergency_checkpoint_dir": str(emer)}},
+    }
+    e, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(HIDDEN),
+                                          config_params=cfg)
+    it = random_dataloader(HIDDEN, 64, 8, seed=0)
+    e.train_batch(data_iter=it)
+    e.save_checkpoint(str(ckpts), backend="npz")
+    e.request_preemption()
+    with pytest.raises(GracefulPreemption) as ei:
+        e.train_batch(data_iter=it)
+    assert ei.value.save_dir == str(ckpts)
+    assert read_latest(str(ckpts)) == ei.value.tag
+    assert not emer.exists()
+
+
+def test_preempt_without_ckpt_dir_warns_but_exits(caplog):
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    e = base_engine(dp=2, micro=2, gas=2)
+    it = random_dataloader(HIDDEN, 64, 4, seed=0)
+    e.train_batch(data_iter=it)
+    e.request_preemption()
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            with pytest.raises(GracefulPreemption) as ei:
+                e.train_batch(data_iter=it)
+    finally:
+        ds_logger.propagate = False
+    assert ei.value.tag is None
+    assert any("WITHOUT a checkpoint" in r.message for r in caplog.records)
+
+
+def test_pipe_preempt_roundtrip(tmp_path):
+    """Preemption grace on the pipeline engine: the layer-granular payload
+    rides the same forced-sync commit and restages on a new grid."""
+    e = pipe_engine(pipe=2, dp=2, micro=MICRO, gas=4)
+    it = random_dataloader(PIPE_HIDDEN, 64, MICRO * 2, seed=0)
+    e.train_batch(data_iter=it)
+    e.save_checkpoint(str(tmp_path))
+    chaos.arm(preempt_after_steps=1)
+    with pytest.raises(GracefulPreemption) as ei:
+        for _ in range(3):
+            e.train_batch(data_iter=it)
+    chaos.disarm()
+    assert ei.value.tag == "preempt_step2"
+    e2 = pipe_engine(pipe=4, dp=2, micro=MICRO, gas=4)
+    it2 = random_dataloader(PIPE_HIDDEN, 64, MICRO * 2, seed=7)
+    e2.train_batch(data_iter=it2)
+    path, client = e2.load_checkpoint(str(tmp_path), auto_resume=True)
+    assert path.endswith("preempt_step2")
+    assert e2.global_steps == 2
+    assert client["data_position"]["samples_consumed"] == 2 * 4 * MICRO * 2
+
+
+# ---------------------------------------------------------------------------
+# emergency checkpoints record the data position (satellite bugfix)
+# ---------------------------------------------------------------------------
+
+def test_emergency_checkpoint_records_data_position(tmp_path):
+    cfg = {
+        "train_batch_size": 8,
+        "steps_per_print": 100,
+        "fp16": {"enabled": True, "initial_scale_power": 8},
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "resilience": {"watchdog": {"enabled": True,
+                                    "max_skipped_steps": 3}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(HIDDEN), config_params=cfg)
+    it = random_dataloader(
+        HIDDEN, 64,
+        engine.train_micro_batch_size_per_gpu() * engine.dp_world_size)
+    for _ in range(2):
+        loss = engine.forward(next(it))
+        engine.backward(loss)
+        engine.step()
+    engine.save_checkpoint(str(tmp_path))
+    expected = reshard.data_position(engine)
+    chaos.arm(nan_grad_steps=10)
+    with pytest.raises(WatchdogAlarm):
+        for _ in range(10):
+            loss = engine.forward(next(it))
+            engine.backward(loss)
+            engine.step()
+    chaos.disarm()
+    emer = [t for t in os.listdir(tmp_path) if t.startswith("emergency")]
+    assert emer
+    with open(tmp_path / emer[0] / "metadata.pkl", "rb") as f:
+        meta = pickle.load(f)
+    pos = meta["client_state"]["data_position"]
+    # 3 more skipped optimizer steps ran before the abort; each consumed
+    # its batch — the recorded offset must count them (the old bug: no
+    # offset at all, so restarts replayed those samples)
+    assert pos["samples_consumed"] > expected["samples_consumed"]
+    assert pos["samples_consumed"] == \
+        pos["micro_steps"] * pos["micro_batch_per_gpu"] * pos["dp_world_size"]
+    assert meta["data_position"] == pos

@@ -26,7 +26,8 @@ from tools.graftlint.core import (REGISTRY, load_baseline, run_paths,  # noqa: E
                                   run_source, save_baseline)
 
 EXPECTED_RULES = {"bare-except", "donated-state", "host-sync",
-                  "rank-branch-collective", "disarmed-discipline"}
+                  "rank-branch-collective", "disarmed-discipline",
+                  "raw-ckpt-write"}
 
 
 def lint(src, path="deepspeed_tpu/x.py", rules=None):
@@ -556,6 +557,110 @@ def test_disarmed_discipline_quiet_with_warning():
 def test_disarmed_discipline_catches_armed_attr_outside_arm_fns():
     got = lint(DISARM_BAD_ATTR_ONLY, rules=["disarmed-discipline"])
     assert rule_names(got) == ["disarmed-discipline"]
+
+
+# ---------------------------------------------------------------------------
+# rule: raw-ckpt-write
+# ---------------------------------------------------------------------------
+
+RUNTIME_PATH = "deepspeed_tpu/runtime/somefile.py"
+
+CKPT_BAD_OPEN = """
+def write_side_metadata(path, meta):
+    with open(path, "w") as f:
+        json.dump(meta, f)
+"""
+
+CKPT_BAD_SAVEZ = """
+def stash_state(path, arrays):
+    np.savez(path, **arrays)
+"""
+
+CKPT_BAD_HASHED_OUTSIDE_COMMIT = """
+def sneaky(path, arrays):
+    savez_hashed(path, **arrays)
+"""
+
+CKPT_BAD_RENAME = """
+def my_own_atomic_commit(tmp, final):
+    os.replace(tmp, final)
+"""
+
+CKPT_GOOD_COMMIT_WRITER = """
+def _write_snapshot_files(path, snap):
+    fname = os.path.join(path, "model_states.npz")
+    np.savez(fname, **snap["arrays"])
+    chaos.file_written(fname)
+    mpath = os.path.join(path, "metadata.pkl")
+    with open(mpath, "wb") as f:
+        pickle.dump(snap["meta"], f)
+    chaos.file_written(mpath)
+"""
+
+CKPT_GOOD_READS_AND_LOOKALIKES = """
+def harmless(path, d, s):
+    with open(path) as f:
+        data = f.read()
+    with open(path, "rb") as f:
+        more = f.read()
+    d2 = d.copy()            # dict.copy, not shutil.copy
+    s2 = s.replace("a", "b")  # str.replace, not os.replace
+    arr = np.load(path)
+    return data, more, d2, s2, arr
+"""
+
+
+def test_raw_ckpt_write_fires_on_each_writer_kind():
+    for src, kind in ((CKPT_BAD_OPEN, "open"),
+                      (CKPT_BAD_SAVEZ, "np.savez"),
+                      (CKPT_BAD_HASHED_OUTSIDE_COMMIT, "savez_hashed"),
+                      (CKPT_BAD_RENAME, "os.replace")):
+        got = lint(src, path=RUNTIME_PATH, rules=["raw-ckpt-write"])
+        assert got and got[0].rule == "raw-ckpt-write", kind
+        assert "atomic commit path" in got[0].message
+    # the bad open fixture flags both the open and the json.dump
+    got = lint(CKPT_BAD_OPEN, path=RUNTIME_PATH, rules=["raw-ckpt-write"])
+    assert len(got) == 2
+
+
+def test_raw_ckpt_write_quiet_in_chaos_hooked_commit_writer():
+    """The payload-writer discipline: writes that feed chaos.file_written
+    are commit-path writes (kill-mid-write tests cover them)."""
+    assert lint(CKPT_GOOD_COMMIT_WRITER, path=RUNTIME_PATH,
+                rules=["raw-ckpt-write"]) == []
+
+
+def test_raw_ckpt_write_quiet_on_reads_and_lookalikes():
+    assert lint(CKPT_GOOD_READS_AND_LOOKALIKES, path=RUNTIME_PATH,
+                rules=["raw-ckpt-write"]) == []
+
+
+def test_raw_ckpt_write_scoped_to_runtime_and_exempts_atomic():
+    # same bad source outside deepspeed_tpu/runtime/: out of scope
+    assert lint(CKPT_BAD_OPEN, path="deepspeed_tpu/serving/x.py",
+                rules=["raw-ckpt-write"]) == []
+    # and atomic.py IS the commit path
+    assert lint(CKPT_BAD_RENAME,
+                path="deepspeed_tpu/runtime/resilience/atomic.py",
+                rules=["raw-ckpt-write"]) == []
+
+
+def test_raw_ckpt_write_suppressible_inline():
+    src = ('def legacy(path, arrays):\n'
+           '    np.savez(path, **arrays)'
+           '  # graftlint: disable=raw-ckpt-write\n')
+    assert lint(src, path=RUNTIME_PATH, rules=["raw-ckpt-write"]) == []
+
+
+def test_raw_ckpt_write_repo_runtime_is_clean():
+    """The acceptance bar: the rule runs over the real runtime tree with
+    an EMPTY baseline — nothing writes around the atomic discipline."""
+    from tools.graftlint.core import run_paths
+
+    result = run_paths(["deepspeed_tpu/runtime"],
+                       rules=[REGISTRY["raw-ckpt-write"]],
+                       use_baseline=False)
+    assert result.new == [], [f.format() for f in result.new]
 
 
 # ---------------------------------------------------------------------------

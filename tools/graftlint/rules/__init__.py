@@ -1,5 +1,6 @@
 """graftlint rule catalog — importing this package registers every rule."""
 from . import bare_except    # noqa: F401
+from . import ckpt_write     # noqa: F401
 from . import disarmed       # noqa: F401
 from . import donation       # noqa: F401
 from . import host_sync      # noqa: F401

@@ -1,0 +1,153 @@
+"""Rule ``raw-ckpt-write``: file writes in the training runtime must ride
+the atomic commit path.
+
+PR 1 bought crash-safe checkpoints (write-to-temp + checksum manifest +
+fsync + atomic rename, ``latest`` last); PR 7's elastic manifests only
+stay trustworthy if NOTHING under ``deepspeed_tpu/runtime/`` writes
+files around that discipline.  A raw ``open(.., "w")`` / ``np.savez`` /
+``pickle.dump`` dropped next to the checkpoint layout is exactly how a
+torn half-file or an unchecksummed metadata sidecar sneaks back in.
+
+Sanctioned writes (quiet):
+
+- anything in ``runtime/resilience/atomic.py`` — the commit path itself
+  (temp-dir writes, manifest, ``latest`` pointer);
+- writes inside a function that also calls ``chaos.file_written(...)``
+  — the payload-writer discipline: commit-path writers target the
+  atomic temp dir and feed every written file to the chaos
+  fault-injection hook, so kill-mid-write tests cover them.  A writer
+  that skips the hook is *also* invisible to the chaos suite, which is
+  its own reason to flag it;
+- per-line ``# graftlint: disable=raw-ckpt-write`` for load-bearing
+  exceptions (the legacy non-atomic savez branch, chaos's intentional
+  corruption helpers), each carrying a comment saying why.
+
+Flagged calls: ``open``/``os.open``/``io.open`` with a write-capable
+mode ('w', 'a', 'x' or '+'), ``np.savez*``/``np.save``, ``savez_hashed``
+(atomic's streaming writer — calling it outside a commit-path function
+still lands an unmanifested file), ``pickle.dump``, ``json.dump``, and
+``shutil.copy*``/``shutil.move``/``os.rename``/``os.replace`` — the
+rename twins because an ad-hoc "atomic" rename outside atomic.py is a
+second, unreviewed commit protocol.
+"""
+import ast
+
+from ..core import Finding, Rule, register
+
+EXEMPT_FILES = ("deepspeed_tpu/runtime/resilience/atomic.py",)
+
+_WRITE_MODE_CHARS = set("wax+")
+# attribute-call writers, keyed by the module receivers they belong to —
+# `dict.copy()` / `str.replace()` must not trip the shutil/os tails
+_MODULE_WRITERS = {
+    ("np", "numpy", "jnp"): {"save", "savez", "savez_compressed"},
+    ("pickle", "json"): {"dump"},
+    ("shutil",): {"copy", "copy2", "copyfile", "copytree", "move"},
+    ("os", "shutil"): {"rename", "replace", "renames"},
+}
+_NAME_WRITERS = {"savez_hashed"}
+
+
+def _mode_is_write(call):
+    """True when an open()-style call's mode argument requests writing.
+    Unknown/dynamic modes count as writes — the rule is a tripwire, and
+    a reader passes a literal 'rb' trivially."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return False  # bare open(path) is read-only
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return bool(_WRITE_MODE_CHARS & set(mode.value))
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, int):
+        return True  # os.open flags: assume writable, demand the hook
+    return True
+
+
+def _flagged(call):
+    """(is_write_call, what) classification for one ast.Call."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        if fn.id == "open":
+            return _mode_is_write(call), "open(.., write mode)"
+        if fn.id in _NAME_WRITERS:
+            return True, f"{fn.id}()"
+        return False, None
+    if isinstance(fn, ast.Attribute):
+        tail = fn.attr
+        recv = fn.value.id if isinstance(fn.value, ast.Name) else None
+        if tail == "open" and recv in ("os", "io"):
+            return _mode_is_write(call), f"{recv}.open(.., write mode)"
+        for receivers, tails in _MODULE_WRITERS.items():
+            if recv in receivers and tail in tails:
+                return True, f"{recv}.{tail}()"
+    return False, None
+
+
+def _calls_file_written(fn_node):
+    """True when the function body feeds the chaos fault-injection hook
+    (``chaos.file_written(...)`` / ``file_written(...)``) — the mark of a
+    commit-path payload writer."""
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Call):
+            f = n.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else None
+            if name == "file_written":
+                return True
+    return False
+
+
+@register
+class RawCkptWriteRule(Rule):
+    name = "raw-ckpt-write"
+    description = ("file write in deepspeed_tpu/runtime/ outside the "
+                   "resilience/atomic.py commit path — checkpoint bytes "
+                   "must go through the atomic/checksum discipline")
+    scopes = ("deepspeed_tpu/runtime",)
+
+    def applies_to(self, path):
+        if path in EXEMPT_FILES:
+            return False
+        return super().applies_to(path)
+
+    def check(self, tree, source, path):
+        # map every node to its enclosing function (for the
+        # chaos.file_written sanction)
+        enclosing = {}
+
+        def _mark(fn):
+            for n in ast.walk(fn):
+                enclosing.setdefault(n, fn)
+
+        for n in ast.walk(tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _mark(n)
+
+        findings = []
+        sanctioned = {}
+        for n in ast.walk(tree):
+            if not isinstance(n, ast.Call):
+                continue
+            is_write, what = _flagged(n)
+            if not is_write:
+                continue
+            fn = enclosing.get(n)
+            if fn is not None:
+                if fn not in sanctioned:
+                    sanctioned[fn] = _calls_file_written(fn)
+                if sanctioned[fn]:
+                    continue
+            findings.append(Finding(
+                rule=self.name, path=path, line=n.lineno,
+                message=(
+                    f"{what} writes a file in the training runtime "
+                    f"outside the atomic commit path; route checkpoint "
+                    f"bytes through resilience/atomic.py (atomic_tag / "
+                    f"savez_hashed inside a commit-path writer that "
+                    f"calls chaos.file_written), or suppress with a "
+                    f"reason if this write is load-bearing")))
+        return findings

@@ -47,7 +47,7 @@ REFERENCE_TFLOPS_PER_CHIP = 64.0
 # spec keys that define a bench configuration (the phase-cache identity)
 _SPEC_KEYS = ("model", "batch", "seq", "steps", "warmup", "scan_layers",
               "remat", "remat_policy", "allow_cpu", "loss_chunk", "offload",
-              "onebit", "sparse")
+              "onebit", "sparse", "zero_stage")
 
 
 def _cfg_hash(spec, base=None):
@@ -197,6 +197,9 @@ def _run_one(args, ctx) -> int:
     if args.onebit:
         return run_onebit_worker(args, jax, jnp, np, device_kind, platform,
                                  n_dev)
+    if args.zero_stage == 3:
+        return run_stage3_worker(args, jax, jnp, np, device_kind, platform,
+                                 n_dev)
     if args.model.startswith("bert"):
         # BERT-large seq128 is the reference's 64-TFLOPS/V100 headline
         # (docs/_posts/2020-05-28-fastest-bert-training.md:15-40); dropout 0
@@ -236,7 +239,7 @@ def _run_one(args, ctx) -> int:
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 2,
+        "zero_optimization": {"stage": min(args.zero_stage, 2),
                               "cpu_offload": bool(args.offload)},
         "mesh": {"data": n_dev, "model": 1, "pipe": 1},
         "steps_per_print": 10 ** 9,
@@ -383,6 +386,84 @@ def run_sparse_worker(args, jax, jnp, np, device_kind, platform):
         "tokens_per_sec_sparse": round(B * S / (sparse_ms / 1000.0), 1),
         "device_kind": device_kind, "platform": platform,
         "batch": B, "heads": H, "seq": S, "head_dim": D, "block": block,
+    }), flush=True)
+    return 0
+
+
+def run_stage3_worker(args, jax, jnp, np, device_kind, platform, n_dev):
+    """ISSUE 8 stage-3 rung: the same model trained at ZeRO stage 3 with
+    SCHEDULED int8 gathers vs the XLA-implicit path, in one attempt.
+    Reports step-time A/B plus the analytic gather wire of both (the
+    byte win — ~3.9x at block 128 vs the bf16 double-gather — is the
+    transferable claim; on a single chip dp=1 disarms the plan and the
+    payload says so instead of publishing a fake ratio)."""
+    import time as _t
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Model, gpt2_config
+
+    model_name = args.model if args.model.startswith("gpt2") else "gpt2-125m"
+
+    def measure(scheduled):
+        cfg = gpt2_config(model_name, n_positions=args.seq,
+                          dtype=jnp.bfloat16, remat=bool(args.remat),
+                          remat_policy=args.remat_policy,
+                          scan_layers=bool(args.scan_layers),
+                          loss_chunk_tokens=args.loss_chunk)
+        model = GPT2Model(cfg)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config_params={
+                "train_batch_size": args.batch * n_dev,
+                "train_micro_batch_size_per_gpu": args.batch,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {
+                    "stage": 3, "stage3_scheduled_gathers": scheduled},
+                "mesh": {"data": n_dev, "model": 1, "pipe": 1},
+                "steps_per_print": 10 ** 9})
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size,
+                           (1, args.batch * n_dev, args.seq))
+        batch = {"input_ids": ids, "labels": ids.copy()}
+        loss = engine.train_batch(batch=batch)      # compile here
+        float(jax.device_get(loss))
+        for _ in range(max(0, args.warmup - 1)):
+            loss = engine.train_batch(batch=batch)
+        float(jax.device_get(loss))   # drain warmup before the timer
+        t0 = _t.time()
+        for _ in range(args.steps):
+            loss = engine.train_batch(batch=batch)
+        float(jax.device_get(loss))
+        ms = (_t.time() - t0) / args.steps * 1000.0
+        # extract the scalars and DROP the engine: holding it through the
+        # other arm's measurement would double params+opt-state HBM
+        armed = bool(getattr(engine, "_s3_sched_armed", False))
+        rep = engine.comm_volume_report()
+        return ms, armed, rep
+
+    sched_ms, armed, rep = measure(True)
+    _phase(f"stage3_scheduled_done:{sched_ms:.1f}")
+    impl_ms, _, _ = measure(False)
+    _phase(f"stage3_implicit_done:{impl_ms:.1f}")
+    quant = rep["param_gather_bytes_per_step"]
+    implicit = rep["baseline"].get("implicit_param_gather_bytes_per_step",
+                                   0)
+    print(json.dumps({
+        "metric": f"ZeRO stage-3 scheduled int8 gathers vs implicit "
+                  f"({model_name} seq{args.seq}, {n_dev} chip)",
+        "value": round(impl_ms / sched_ms, 3),
+        "unit": "x step-time vs implicit",
+        "vs_baseline": round(impl_ms / sched_ms, 3),
+        "scheduled_ms": round(sched_ms, 1),
+        "implicit_ms": round(impl_ms, 1),
+        "s3_scheduled_armed": armed,
+        "gather_bytes_scheduled": quant,
+        "gather_bytes_implicit": implicit,
+        "gather_wire_reduction": round(implicit / quant, 2) if quant
+        else None,
+        "device_kind": device_kind, "platform": platform,
+        "n_devices": n_dev, "batch_per_chip": args.batch,
     }), flush=True)
     return 0
 
@@ -587,6 +668,11 @@ def run_parent(args) -> int:
          "timeout": max(500, args.budget_s // 2)},
         {"model": "gpt2-350m", "batch": 16, "seq": 1024, "steps": 15,
          "timeout": max(400, args.budget_s // 3)},
+        # ISSUE 8 stage-3 rung: scheduled int8 gathers vs implicit, A/B in
+        # one attempt (run_stage3_worker) — records the stage-3 wire win
+        # in the perf trajectory, phase-cached under its own config hash
+        {"model": "gpt2-350m", "batch": 16, "seq": 1024, "steps": 10,
+         "zero_stage": 3, "timeout": max(400, args.budget_s // 3)},
         {"model": "gpt2-125m", "batch": 8, "seq": 512, "steps": 10,
          "timeout": max(300, args.budget_s // 3)},
         {"model": "gpt2-125m", "batch": 4, "seq": 256, "steps": 5,
@@ -811,6 +897,10 @@ def main():
                    help="debug only: let the worker publish a CPU number")
     p.add_argument("--offload", type=int, default=0,
                    help="ZeRO-Offload: host fp32 master + C++ AVX Adam")
+    p.add_argument("--zero-stage", dest="zero_stage", type=int, default=2,
+                   help="ZeRO stage for the training bench; 3 runs the "
+                        "scheduled-vs-implicit gather A/B "
+                        "(run_stage3_worker)")
     p.add_argument("--onebit", type=int, default=0,
                    help="BASELINE config 5: OneBitAdam wire path, warmup vs "
                         "post-freeze step time")

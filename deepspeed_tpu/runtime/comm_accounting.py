@@ -186,11 +186,17 @@ def param_gather_collectives(
         leaves: Sequence[LeafSpec], dp: int, *,
         quantized: bool = False,
         block_size: int = DEFAULT_BLOCK_SIZE,
-        param_dtype: str = "bfloat16") -> List[Collective]:
+        param_dtype: str = "bfloat16",
+        count_per_step: int = 1) -> List[Collective]:
     """Collectives of the per-step parameter materialization: the all-gather
-    of updated (ZeRO-sharded) weights back to the replicated compute layout.
+    of (ZeRO-sharded) weights back to the replicated compute layout.
     Dense: one all-gather in the compute dtype per shardable leaf.
-    Quantized (qwZ): all-gather int8 blocks + fp32 scales instead."""
+    Quantized (qwZ / scheduled stage-3): all-gather int8 blocks + fp32
+    scales instead.  ``count_per_step`` scales to one optimizer step: the
+    stage-1/2 post-step materialization gathers once, the scheduled
+    stage-3 path gathers once per MICRO-step (gas), and the implicit
+    stage-3 path under a remat'd backward fetches every weight TWICE per
+    micro (forward + backward recompute) — 2*gas."""
     es = DTYPE_BYTES[param_dtype]
     out: List[Collective] = []
     for leaf in leaves:
@@ -201,16 +207,19 @@ def param_gather_collectives(
             out.append(Collective(
                 name=f"param_ag:{leaf.name}", op="all-gather",
                 dtype=param_dtype, elements=n, axis_size=dp,
-                bytes_per_device=all_gather_bytes(n, es, dp)))
+                bytes_per_device=all_gather_bytes(n, es, dp),
+                count_per_step=count_per_step))
             continue
         _, nb, npad = block_layout(n // dp, block_size)
         out += [
             Collective(name=f"qwz_ag:{leaf.name}", op="all-gather",
                        dtype="int8", elements=dp * npad, axis_size=dp,
-                       bytes_per_device=all_gather_bytes(dp * npad, 1, dp)),
+                       bytes_per_device=all_gather_bytes(dp * npad, 1, dp),
+                       count_per_step=count_per_step),
             Collective(name=f"qwz_scales:{leaf.name}", op="all-gather",
                        dtype="float32", elements=dp * nb, axis_size=dp,
-                       bytes_per_device=all_gather_bytes(dp * nb, 4, dp)),
+                       bytes_per_device=all_gather_bytes(dp * nb, 4, dp),
+                       count_per_step=count_per_step),
         ]
     return out
 
@@ -223,13 +232,26 @@ def volume_report(leaves: Sequence[LeafSpec], dp: int, *,
                   block_size: int = DEFAULT_BLOCK_SIZE,
                   intra_size: int = 0,
                   param_dtype: str = "bfloat16",
-                  gather_params: bool = True) -> dict:
+                  gather_params: bool = True,
+                  param_gathers_per_step: int = 1,
+                  implicit_param_gathers_per_step: Optional[int] = None
+                  ) -> dict:
     """Full per-step report for one configuration, with the dense-fp32
     baseline alongside so byte reductions are assertable directly.
 
     ``quantized_weights_mask``: per-leaf qwZ eligibility (the engine's
     offload push keeps TP-mixed/non-divisible leaves dense); None means
-    ``quantized_weights`` applies to every shardable leaf."""
+    ``quantized_weights`` applies to every shardable leaf.
+
+    ``param_gathers_per_step``: how often the ACTIVE config materializes
+    its partitioned weights per optimizer step (1 for the stage-1/2
+    post-step gather, gas for the scheduled stage-3 per-micro gather,
+    2*gas for implicit stage-3 under a remat'd backward — the forward
+    gather plus the recompute refetch).  ``implicit_param_gathers_per_
+    step``: when set, the baseline additionally prices the implicit
+    XLA-scheduled stage-3 path (dense gathers at that count) as
+    ``implicit_param_gather_bytes_per_step`` — the honest yardstick the
+    scheduled path's acceptance bound is judged against."""
     grads = grad_exchange_collectives(
         leaves, dp, quantized=quantized_gradients, block_size=block_size,
         intra_size=intra_size, count_per_step=gas)
@@ -240,14 +262,16 @@ def volume_report(leaves: Sequence[LeafSpec], dp: int, *,
                         if not q]
         q_leaves = [l for l, q in zip(leaves, quantized_weights_mask) if q]
         params = param_gather_collectives(
-            dense_leaves, dp, quantized=False, param_dtype=param_dtype)
+            dense_leaves, dp, quantized=False, param_dtype=param_dtype,
+            count_per_step=param_gathers_per_step)
         params += param_gather_collectives(
             q_leaves, dp, quantized=True, block_size=block_size,
-            param_dtype=param_dtype)
+            param_dtype=param_dtype, count_per_step=param_gathers_per_step)
     else:
         params = param_gather_collectives(
             leaves, dp, quantized=quantized_weights,
-            block_size=block_size, param_dtype=param_dtype)
+            block_size=block_size, param_dtype=param_dtype,
+            count_per_step=param_gathers_per_step)
     base = grad_exchange_collectives(leaves, dp, quantized=False,
                                      count_per_step=gas)
     base_rs = sum(c.bytes_per_step for c in base if c.op == "reduce-scatter")
@@ -256,6 +280,8 @@ def volume_report(leaves: Sequence[LeafSpec], dp: int, *,
         if gather_params else []
     grad_bytes = sum(c.bytes_per_step for c in grads)
     param_bytes = sum(c.bytes_per_step for c in params)
+    param_q_bytes = sum(c.bytes_per_step for c in params
+                        if c.name.startswith(("qwz_ag", "qwz_scales")))
     report = {
         "config": {
             "dp": dp, "gas": gas,
@@ -264,11 +290,14 @@ def volume_report(leaves: Sequence[LeafSpec], dp: int, *,
             "quantization_block_size": int(block_size),
             "hierarchical_intra_size": int(intra_size or 0),
             "param_dtype": param_dtype,
+            "param_gathers_per_step": int(param_gathers_per_step),
         },
         "collectives": [asdict(c) | {"bytes_per_step": c.bytes_per_step}
                         for c in grads + params],
         "grad_exchange_bytes_per_step": grad_bytes,
         "param_gather_bytes_per_step": param_bytes,
+        "param_gather_quantized_bytes_per_step": param_q_bytes,
+        "param_gather_dense_bytes_per_step": param_bytes - param_q_bytes,
         "total_bytes_per_step": grad_bytes + param_bytes,
         "inter_bytes_per_step": sum(c.bytes_per_step
                                     for c in grads + params
@@ -281,6 +310,10 @@ def volume_report(leaves: Sequence[LeafSpec], dp: int, *,
                 sum(c.bytes_per_step for c in base_params),
         },
     }
+    if implicit_param_gathers_per_step is not None:
+        report["baseline"]["implicit_param_gather_bytes_per_step"] = \
+            sum(c.bytes_per_step for c in base_params) \
+            * int(implicit_param_gathers_per_step)
     baseline_total = report["baseline"]["fp32_grad_exchange_bytes_per_step"]
     report["grad_reduction_vs_fp32"] = (
         baseline_total / grad_bytes if grad_bytes else None)

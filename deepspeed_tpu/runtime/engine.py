@@ -195,6 +195,9 @@ class DeepSpeedEngine:
         self._pending_state = None
         self._train_mode = True
         self._pending_loss = None
+        # scheduled stage-3: the staged forward's vjp stash (gathered
+        # weights + activations) awaiting its backward
+        self._pending_s3_stash = None
         self.summary_writer = None
         if self.tensorboard_enabled() and jax.process_index() == 0:
             from deepspeed_tpu.utils.tb_writer import SummaryWriter
@@ -586,6 +589,7 @@ class DeepSpeedEngine:
                         if self._use_loss_scaler() else None),
                 skipped_steps=rep, rng=rep)
             self._batch_sharding_cache = {}
+            self._arm_stage3(stage, dp, params_template)
             self._arm_quantized_collectives(stage, dp)
             return self._shardings
         # sparse_gradients under plain DP (reference engine.py:1227-1265
@@ -632,8 +636,178 @@ class DeepSpeedEngine:
                     if self._use_loss_scaler() else None),
             skipped_steps=rep, rng=rep)
         self._batch_sharding_cache = {}
+        self._arm_stage3(stage, dp, params_template)
         self._arm_quantized_collectives(stage, dp)
         return self._shardings
+
+    def _arm_stage3(self, stage, dp, params_template):
+        """Decide whether stage 3 runs the SCHEDULED gather path (ISSUE 8):
+        a compile-time per-layer-block plan (runtime/zero/stage3.py) of
+        quantized (int8 + fp32 scales) all-gathers, one per partitioned
+        leaf per micro-step, with the gathered weight persisted fwd->bwd
+        as a vjp residual and donated/freed at wgrad.  Disarmed, stage 3
+        falls back to the implicit path — XLA inserts full-precision
+        gathers at every use site (and again in a remat'd backward) —
+        with every blocker named loudly (the qgZ/OneBit discipline)."""
+        import warnings
+
+        import jax
+        from jax.sharding import NamedSharding
+
+        from deepspeed_tpu.runtime.zero import stage3 as s3
+
+        zc = self._config.zero_config
+        self._s3_sched_armed = False
+        self._s3_plan = None
+        if stage != 3:
+            return
+        dims_tree = jax.tree_util.tree_map(
+            _spec_data_dim, self._shardings.params,
+            is_leaf=lambda x: isinstance(x, NamedSharding))
+        dims = jax.tree_util.tree_leaves(dims_tree,
+                                         is_leaf=lambda x: x is None)
+        names = _leaf_path_names(params_template)
+        shapes = [tuple(l.shape)
+                  for l in jax.tree_util.tree_leaves(params_template)]
+        plan = s3.build_gather_plan(
+            names, shapes, dims, dp,
+            block_size=zc.quantization_block_size,
+            param_dtype=str(np.dtype(self.compute_dtype)))
+        self._s3_plan = plan
+        self._s3_dims = dims_tree
+        blockers = []
+        if not zc.stage3_scheduled_gathers:
+            blockers.append("zero_optimization.stage3_scheduled_gathers="
+                            "false")
+        if dp <= 1:
+            blockers.append("data-parallel degree is 1 (nothing is "
+                            "partitioned)")
+        if self._offload:
+            blockers.append("cpu_offload=true (params materialize through "
+                            "the offload push, which has its own qwZ wire)")
+        if self.mesh.shape.get("pipe", 1) != 1:
+            blockers.append(f"pipe={self.mesh.shape.get('pipe')}")
+        if self.sp_world_size != 1:
+            blockers.append(f"seq={self.sp_world_size}")
+        if not blockers and plan.n_gathered_leaves == 0:
+            blockers.append("no parameter leaf is partitionable over "
+                            "'data' (all too small/indivisible)")
+        budget = zc.stage3_prefetch_budget
+        if not blockers and not plan.within_budget(budget):
+            blockers.append(
+                f"gather plan needs {plan.gathered_bytes} B of gathered "
+                f"weights live fwd->bwd, over stage3_prefetch_budget="
+                f"{budget} B — raise the budget or accept the implicit "
+                f"path's per-use gathers")
+        if blockers:
+            log_dist(
+                "ZeRO stage-3: scheduled quantized gathers DISARMED — "
+                f"falling back to XLA-implicit per-use all-gathers "
+                f"({'; '.join(blockers)})", ranks=[0],
+                level=logging.WARNING)
+            return
+        self._s3_sched_armed = True
+        # the bwd jit donates the stash; gathered-weight residuals are
+        # donor-only (they alias no output), which XLA reports once per
+        # compile with this warning — expected, same as the zb-h1 stash
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        log_dist(
+            f"ZeRO stage-3: scheduled quantized gathers armed — "
+            f"{plan.n_gathered_leaves} leaves in {len(plan.blocks)} "
+            f"layer blocks, {plan.wire_bytes_per_gather} B int8+scales "
+            f"wire per gather, {plan.gathered_bytes} B gathered peak "
+            f"(budget {budget or 'unbounded'})", ranks=[0])
+
+    def stage3_report(self):
+        """The compile-time gather plan's report (blocks, per-block bytes,
+        peak gathered footprint) plus arming status — the numbers
+        stage3_prefetch_budget is sized from.  None below stage 3 or
+        before state build."""
+        if getattr(self, "_s3_plan", None) is None:
+            return None
+        report = self._s3_plan.report()
+        report["armed"] = bool(self._s3_sched_armed)
+        report["prefetch_budget"] = \
+            self._config.zero_config.stage3_prefetch_budget
+        return report
+
+    def _make_stage3_gather(self):
+        """params(sharded) -> params(replicated) through the plan's
+        quantized all-gathers, emitted in forward block order so XLA's
+        latency-hiding scheduler prefetches block k+1's gather behind
+        block k's compute.  Straight-through vjp: gradients flow back
+        constrained onto the ZeRO shard (one reduce-scatter per leaf)."""
+        import jax
+
+        from deepspeed_tpu.runtime.custom_collectives import \
+            quantized_all_gather
+
+        dims = self._s3_dims
+        mesh = self.mesh
+        block = self._config.zero_config.quantization_block_size
+
+        def gather(params):
+            def one(dim, p):
+                if dim is None:
+                    return p
+                return quantized_all_gather(
+                    p, mesh, dim=dim, block_size=block,
+                    out_dtype=p.dtype)
+
+            return jax.tree_util.tree_map(one, dims, params,
+                                          is_leaf=lambda x: x is None)
+
+        return gather
+
+    def _make_stage3_fwd(self):
+        """Forward half of the staged stage-3 micro step: gather once,
+        compute the loss, and return the vjp closure (a tree_util.Partial
+        whose residuals INCLUDE the gathered weights) as the stash that
+        crosses to the backward jit — the PR-6 ZB stash idiom.  The
+        engine state is NOT donated here: it stays alive until backward
+        commits it."""
+        import jax
+        import jax.numpy as jnp
+
+        gas = self.gradient_accumulation_steps()
+        model = self.module
+        gather = self._make_stage3_gather()
+
+        def s3_fwd(state: TrainState, batch):
+            rng = jax.random.fold_in(state.rng,
+                                     state.micro_step + state.step * 131071)
+            scale = state.scaler.loss_scale if state.scaler is not None \
+                else jnp.float32(1.0)
+
+            def loss_fn(shards):
+                full = gather(shards)
+                loss, _ = model.loss(full, batch, rng, train=True)
+                return loss.astype(jnp.float32) * scale / gas, loss
+
+            _, vjp, loss = jax.vjp(loss_fn, state.params, has_aux=True)
+            return loss, vjp
+
+        return s3_fwd
+
+    def _make_stage3_bwd(self):
+        """Backward half: evaluate the stash into gradients (they arrive
+        ZeRO-sharded through the gather's straight-through cotangent
+        constraint — the accumulator add is collective-free) and commit
+        the micro step.  Donates BOTH the state (in-place accum) and the
+        stash, so the gathered weights free at wgrad instead of
+        surviving to peak memory."""
+        import jax
+        import jax.numpy as jnp
+
+        def s3_bwd(state: TrainState, stash):
+            grads, = stash(jnp.float32(1.0))
+            accum = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), state.accum, grads)
+            return state._replace(accum=accum,
+                                  micro_step=state.micro_step + 1)
+
+        return s3_bwd
 
     def _arm_quantized_collectives(self, stage, dp):
         """Decide whether the ZeRO++-style quantized collectives run
@@ -678,9 +852,17 @@ class DeepSpeedEngine:
         if zc.quantized_weights:
             if self._offload and dp > 1:
                 self._qwz_armed = True
+            elif getattr(self, "_s3_sched_armed", False):
+                # stage-3's scheduled gathers ARE the int8 weight wire:
+                # the ask is satisfied, nothing to disarm
+                log_dist(
+                    "ZeRO qwZ: quantized_weights rides the stage-3 "
+                    "scheduled gather plan (int8 blocks + fp32 scales per "
+                    "micro-step)", ranks=[0])
             else:
                 blocker = "cpu_offload=false (the int8 weight gather rides " \
-                          "the offload parameter push)" \
+                          "the offload parameter push or the stage-3 " \
+                          "scheduled plan)" \
                     if not self._offload else "data-parallel degree is 1"
                 log_dist(
                     f"ZeRO qwZ: quantized_weights DISARMED — parameters "
@@ -933,6 +1115,8 @@ class DeepSpeedEngine:
             if getattr(self, "_csr_dp_flags", None) is not None else None
         qgz_exchange = self._make_quantized_grad_exchange() \
             if getattr(self, "_qgz_armed", False) else None
+        s3_gather = self._make_stage3_gather() \
+            if getattr(self, "_s3_sched_armed", False) else None
 
         def micro(state: TrainState, batch):
             rng = jax.random.fold_in(state.rng, state.micro_step + state.step * 131071)
@@ -945,7 +1129,12 @@ class DeepSpeedEngine:
                 grads, loss = qgz_exchange(state.params, batch, rng, scale)
             else:
                 def loss_fn(params):
-                    loss, metrics = model.loss(params, batch, rng, train=True)
+                    # scheduled stage-3: ONE planned quantized gather per
+                    # partitioned leaf; its output is a vjp residual, so
+                    # the backward reuses it instead of regathering
+                    full = s3_gather(params) if s3_gather is not None \
+                        else params
+                    loss, metrics = model.loss(full, batch, rng, train=True)
                     return loss.astype(jnp.float32) * scale / gas, (loss, metrics)
 
                 grads, (loss, metrics) = jax.grad(loss_fn, has_aux=True)(state.params)
@@ -1782,6 +1971,21 @@ class DeepSpeedEngine:
                                   out_shardings=(sh, None))
         self._jit_apply = jax.jit(apply_, donate_argnums=(0,), out_shardings=(sh, None))
 
+        # scheduled stage-3 staged API: the micro step splits into a
+        # non-donating forward (returns the vjp stash) and a backward
+        # that donates state + stash — gathered weights free at wgrad
+        self._jit_s3_fwd = None
+        self._jit_s3_bwd = None
+        if getattr(self, "_s3_sched_armed", False):
+            self._jit_s3_fwd = jax.jit(self._make_stage3_fwd())
+            # no out_shardings: the output TrainState inherits the input
+            # shardings (accum add is shard-local through the gather's
+            # cotangent constraint), and jax 0.4.37 drops the HLO
+            # buffer_donor table — the stash-donation contract — when
+            # out_shardings is given alongside donate_argnums
+            self._jit_s3_bwd = jax.jit(self._make_stage3_bwd(),
+                                       donate_argnums=(0, 1))
+
         gas = self.gradient_accumulation_steps()
 
         def fused(state, stacked_batch, lr):
@@ -1853,15 +2057,11 @@ class DeepSpeedEngine:
             _spec_data_dim, sh_tree,
             is_leaf=lambda x: isinstance(x, NamedSharding)),
             is_leaf=lambda x: x is None)
-        flat, _ = jax.tree_util.tree_flatten_with_path(self.state.params)
-        leaves = []
-        for (path, leaf), dim in zip(flat, dims):
-            parts = [str(getattr(p, "key", getattr(p, "idx",
-                                                   getattr(p, "name", p))))
-                     for p in path]
-            leaves.append(ca.LeafSpec(name="/".join(parts) or "param",
-                                      shape=tuple(leaf.shape),
-                                      shard_dim=dim))
+        names = _leaf_path_names(self.state.params)
+        shapes = [tuple(l.shape)
+                  for l in jax.tree_util.tree_leaves(self.state.params)]
+        leaves = [ca.LeafSpec(name=n, shape=s, shard_dim=dim)
+                  for n, s, dim in zip(names, shapes, dims)]
         qwz_ok = [m is not None for m in self._qwz_leaf_meta()] \
             if (self._offload and getattr(self, "_qwz_armed", False)) \
             else [False] * len(leaves)
@@ -1876,11 +2076,16 @@ class DeepSpeedEngine:
 
         Covers the ZeRO gradient exchange (dense reduce-scatter/all-reduce
         or the qgZ quantized all_to_alls, x gradient-accumulation steps)
-        and the per-step weight materialization (stage-1/2 compute-dtype
-        all-gather; the offload push, int8+scales under qwZ).  Not modeled:
-        the CSR-sparse and 1-bit wire paths (proved by HLO byte tests in
-        tests/unit/test_csr.py / test_onebit.py) and stage-3 per-use
-        parameter gathers (scheduled by XLA inside fwd/bwd).
+        and the per-step weight materialization: the stage-1/2
+        compute-dtype all-gather, the offload push (int8+scales under
+        qwZ), and stage 3 — scheduled (one quantized gather per
+        partitioned leaf per micro-step) or implicit (dense compute-dtype
+        gathers at every use site, counted TWICE per micro for the
+        remat'd-backward refetch; the baseline's
+        ``implicit_param_gather_bytes_per_step`` prices the same so the
+        scheduled path is judged against an honest yardstick).  Not
+        modeled: the CSR-sparse and 1-bit wire paths (proved by HLO byte
+        tests in tests/unit/test_csr.py / test_onebit.py).
 
         Requires built state — call forward/train_batch/init_from_batch
         first."""
@@ -1898,16 +2103,28 @@ class DeepSpeedEngine:
         leaves, qwz_ok = self._comm_leaf_specs()
         qwz_armed = getattr(self, "_qwz_armed", False)
 
+        gas = self.gradient_accumulation_steps()
+        s3_sched = getattr(self, "_s3_sched_armed", False)
+        if stage == 3 and dp > 1:
+            # scheduled: one quantized gather per micro; implicit: XLA
+            # gathers per use site — fwd plus the remat'd-bwd refetch
+            gathers_per_step = gas if s3_sched else 2 * gas
+        else:
+            gathers_per_step = 1
         report = ca.volume_report(
             leaves, dp,
-            gas=self.gradient_accumulation_steps(),
+            gas=gas,
             quantized_gradients=getattr(self, "_qgz_armed", False),
-            quantized_weights=qwz_armed,
+            quantized_weights=qwz_armed or s3_sched,
             quantized_weights_mask=qwz_ok if qwz_armed else None,
             block_size=zc.quantization_block_size,
             intra_size=getattr(self, "_qgz_intra", 0),
             param_dtype=compute,
-            gather_params=dp > 1 and (self._offload or stage in (1, 2)))
+            gather_params=dp > 1 and (self._offload
+                                      or stage in (1, 2, 3)),
+            param_gathers_per_step=gathers_per_step,
+            implicit_param_gathers_per_step=(
+                2 * gas if stage == 3 and dp > 1 else None))
         report["config"].update({"zero_stage": stage,
                                  "compute_dtype": compute})
         # the accounting models the dense/quantized ZeRO exchange; when the
@@ -1933,12 +2150,20 @@ class DeepSpeedEngine:
             if report["grad_path_modeled"] else None
 
     def _annotate_comm(self, metrics):
-        """Copy a step's metrics dict and attach comm_bytes_per_step when
-        the accounting models the active wire path."""
+        """Copy a step's metrics dict and attach comm_bytes_per_step (plus
+        the dense-vs-quantized parameter-gather split) when the accounting
+        models the active wire path."""
         metrics = dict(metrics)
         comm = self._comm_bytes_per_step()
         if comm is not None:
             metrics["comm_bytes_per_step"] = comm
+            report = self.comm_volume_report()
+            metrics["param_gather_bytes_per_step"] = \
+                report["param_gather_bytes_per_step"]
+            metrics["param_gather_dense_bytes_per_step"] = \
+                report["param_gather_dense_bytes_per_step"]
+            metrics["param_gather_quantized_bytes_per_step"] = \
+                report["param_gather_quantized_bytes_per_step"]
         return metrics
 
     def train(self, mode=True):
@@ -1962,7 +2187,8 @@ class DeepSpeedEngine:
         eval_loss), which never touches the train state."""
         if not self._train_mode:
             return self.eval_loss(batch)
-        if self._pending_state is not None:
+        if self._pending_state is not None \
+                or self._pending_s3_stash is not None:
             # fail here with the real story, not deep in XLA with a cryptic
             # "buffer was donated" once the dead state is passed back in
             raise RuntimeError(
@@ -1996,6 +2222,16 @@ class DeepSpeedEngine:
         import jax
 
         with jax.set_mesh(self.mesh):
+            if getattr(self, "_jit_s3_fwd", None) is not None:
+                # scheduled stage-3: the forward does NOT donate the state
+                # — it stays alive; what stages is the vjp stash, whose
+                # residuals hold the once-gathered weights for backward
+                loss, self._pending_s3_stash = \
+                    self._jit_s3_fwd(self.state, dev_batch)
+                self._pending_loss = loss
+                if self.wall_clock_breakdown():
+                    self.timers(FORWARD_MICRO_TIMER).stop()
+                return loss
             if self._offload:
                 new_state, loss, grads = self._jit_micro(self.state,
                                                          dev_batch)
@@ -2021,6 +2257,20 @@ class DeepSpeedEngine:
         """
         if self.wall_clock_breakdown():
             self.timers(BACKWARD_MICRO_TIMER).start()
+        if self._pending_s3_stash is not None:
+            # scheduled stage-3: evaluate the stash (gradients land
+            # sharded through the gather's cotangent constraint) and
+            # donate it — the gathered weights free here, at wgrad
+            import jax
+
+            with jax.set_mesh(self.mesh):
+                self.state = self._jit_s3_bwd(self.state,
+                                              self._pending_s3_stash)
+            self._pending_s3_stash = None
+            self.micro_steps += 1
+            if self.wall_clock_breakdown():
+                self.timers(BACKWARD_MICRO_TIMER).stop()
+            return loss
         assert self._pending_state is not None, \
             "backward() called without a preceding forward()"
         self.state = self._pending_state
@@ -2047,7 +2297,8 @@ class DeepSpeedEngine:
         """Optimizer step at accumulation boundaries (reference engine.py:1016)."""
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).start()
-        assert self._pending_state is None, \
+        assert self._pending_state is None \
+            and self._pending_s3_stash is None, \
             "step() called between forward() and backward()"
         if self.is_gradient_accumulation_boundary():
             self._chaos_poison_accum()
@@ -2683,10 +2934,12 @@ class DeepSpeedEngine:
     def _assert_saveable(self):
         assert self.state is not None, \
             "nothing to save; train state not built"
-        assert self._pending_state is None, \
+        assert self._pending_state is None \
+            and self._pending_s3_stash is None, \
             "save_checkpoint between forward() and backward(): the micro " \
-            "step donated the committed state's buffers — commit the " \
-            "in-flight micro-batch with backward() first"
+            "step donated the committed state's buffers (or a stage-3 " \
+            "stash is in flight) — commit the in-flight micro-batch with " \
+            "backward() first"
         if _tree_has_deleted(self.state):
             raise RuntimeError(
                 "cannot checkpoint: the train state's buffers were donated "
@@ -3250,6 +3503,7 @@ class DeepSpeedEngine:
         self._pending_state = None
         self._pending_loss = None
         self._pending_grads = None
+        self._pending_s3_stash = None
         if getattr(self, "_pending_fetches", None):
             self._pending_fetches = []
 
@@ -3422,6 +3676,22 @@ def _tree_has_deleted(tree, first_only=False):
             if first_only:
                 return False
     return False
+
+
+def _leaf_path_names(tree):
+    """'/'-joined pytree path of every leaf, in flatten order — the leaf
+    naming shared by the stage-3 gather plan (block grouping) and the
+    comm-accounting leaf specs, so the two can never drift."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    names = []
+    for path, _leaf in flat:
+        parts = [str(getattr(p, "key", getattr(p, "idx",
+                                               getattr(p, "name", p))))
+                 for p in path]
+        names.append("/".join(parts) or "param")
+    return names
 
 
 def _spec_data_dim(sh):

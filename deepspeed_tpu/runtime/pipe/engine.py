@@ -61,7 +61,22 @@ class PipelineEngine(DeepSpeedEngine):
         super().__init__(*args, **kwargs)
         assert isinstance(self.module, PipelineModule), \
             "PipelineEngine requires a PipelineModule model"
-        assert self.zero_optimization_stage() <= 2
+        if self.zero_optimization_stage() > 2:
+            # stage-3 parameter partitioning (and its scheduled gather
+            # plan) lives in the base engine: here each stage's params
+            # are already stage-local on a submesh, and the per-chunk
+            # jits have no cross-stage axis to gather over.  Downgrade
+            # to stage 2 (optimizer + gradient sharding still apply)
+            # instead of dying on an assert.
+            log_dist(
+                "PipelineEngine: ZeRO stage-3 scheduled gathers DISARMED "
+                "— parameters are already partitioned per pipeline stage "
+                "and the stage-3 gather plan has no cross-stage 'data' "
+                "shard to gather; running ZeRO stage 2 (optimizer state "
+                "+ gradient sharding over 'data')", ranks=[0],
+                level=logging.WARNING)
+            self._config.zero_config.stage = 2
+            self._config.zero_optimization_stage = 2
 
         import jax
 

@@ -29,6 +29,10 @@ from deepspeed_tpu.runtime.zero.constants import (
     ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE_DEFAULT,
     ZERO_OPTIMIZATION_REDUCE_SCATTER,
     ZERO_OPTIMIZATION_REDUCE_SCATTER_DEFAULT, ZERO_OPTIMIZATION_STAGE,
+    ZERO_OPTIMIZATION_STAGE3_PREFETCH_BUDGET,
+    ZERO_OPTIMIZATION_STAGE3_PREFETCH_BUDGET_DEFAULT,
+    ZERO_OPTIMIZATION_STAGE3_SCHEDULED_GATHERS,
+    ZERO_OPTIMIZATION_STAGE3_SCHEDULED_GATHERS_DEFAULT,
     ZERO_OPTIMIZATION_STAGE_DEFAULT)
 
 
@@ -49,6 +53,8 @@ class DeepSpeedZeroConfig:
         self.hierarchical_allreduce = None
         self.hierarchical_intra_size = None
         self.quantization_block_size = None
+        self.stage3_scheduled_gathers = None
+        self.stage3_prefetch_budget = None
 
         if ZERO_OPTIMIZATION in param_dict:
             zero_config_dict = param_dict[ZERO_OPTIMIZATION]
@@ -102,6 +108,15 @@ class DeepSpeedZeroConfig:
             ZERO_OPTIMIZATION_QUANTIZATION_BLOCK_SIZE_DEFAULT))
         assert self.quantization_block_size > 0, \
             "zero_optimization.quantization_block_size must be positive"
+        self.stage3_scheduled_gathers = get_scalar_param(
+            d, ZERO_OPTIMIZATION_STAGE3_SCHEDULED_GATHERS,
+            ZERO_OPTIMIZATION_STAGE3_SCHEDULED_GATHERS_DEFAULT)
+        self.stage3_prefetch_budget = int(get_scalar_param(
+            d, ZERO_OPTIMIZATION_STAGE3_PREFETCH_BUDGET,
+            ZERO_OPTIMIZATION_STAGE3_PREFETCH_BUDGET_DEFAULT))
+        assert self.stage3_prefetch_budget >= 0, \
+            "zero_optimization.stage3_prefetch_budget must be >= 0 (0 = " \
+            "unbounded)"
 
     def repr(self):
         return dict(stage=self.stage,
@@ -118,7 +133,9 @@ class DeepSpeedZeroConfig:
                     quantized_weights=self.quantized_weights,
                     hierarchical_allreduce=self.hierarchical_allreduce,
                     hierarchical_intra_size=self.hierarchical_intra_size,
-                    quantization_block_size=self.quantization_block_size)
+                    quantization_block_size=self.quantization_block_size,
+                    stage3_scheduled_gathers=self.stage3_scheduled_gathers,
+                    stage3_prefetch_budget=self.stage3_prefetch_budget)
 
     def __repr__(self):
         return str(self.repr())

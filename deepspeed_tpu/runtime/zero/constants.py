@@ -2,7 +2,7 @@
 
 Format:
   "zero_optimization": {
-    "stage": [0|1|2],
+    "stage": [0|1|2|3],
     "allgather_partitions": true,
     "allgather_bucket_size": 500000000,
     "reduce_scatter": true,
@@ -86,5 +86,22 @@ ZERO_OPTIMIZATION_HIERARCHICAL_INTRA_SIZE_DEFAULT = 0
 
 ZERO_OPTIMIZATION_QUANTIZATION_BLOCK_SIZE = "quantization_block_size"
 ZERO_OPTIMIZATION_QUANTIZATION_BLOCK_SIZE_DEFAULT = 128
+
+# --- scheduled stage-3 (ISSUE 8) -------------------------------------------
+# stage3_scheduled_gathers: at stage 3, gather each partitioned weight ONCE
+# per micro-step as blockwise int8 + fp32 scales along a compile-time
+# per-layer-block plan (runtime/zero/stage3.py), persisting the gathered
+# weight fwd->bwd (no remat refetch) and freeing it at wgrad.  False keeps
+# the implicit path: XLA inserts full-precision gathers at every use site.
+ZERO_OPTIMIZATION_STAGE3_SCHEDULED_GATHERS = "stage3_scheduled_gathers"
+ZERO_OPTIMIZATION_STAGE3_SCHEDULED_GATHERS_DEFAULT = True
+
+# stage3_prefetch_budget: max bytes of gathered (replicated, compute-dtype)
+# weights the scheduled plan may hold live at once — they persist from the
+# forward gather to wgrad, so the whole plan's footprint counts.  0 =
+# unbounded.  A plan over budget DISARMs back to the implicit XLA path
+# (lower peak memory, more wire) with a warning naming the bytes.
+ZERO_OPTIMIZATION_STAGE3_PREFETCH_BUDGET = "stage3_prefetch_budget"
+ZERO_OPTIMIZATION_STAGE3_PREFETCH_BUDGET_DEFAULT = 0
 
 ZERO_OPTIMIZATION_DEFAULT = ZERO_OPTIMIZATION_DISABLED

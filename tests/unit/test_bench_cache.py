@@ -34,6 +34,14 @@ def test_cfg_hash_stable_and_spec_sensitive():
     # keys outside the spec identity (timeouts etc.) don't change the hash
     assert b._cfg_hash({"model": "gpt2-125m", "batch": 8,
                         "timeout": 999}, base) == h1
+    # the stage-3 rung (ISSUE 8) is its own config identity: a dead A/B
+    # attempt leaves phase-cache evidence without shadowing the stage-2
+    # rung of the same shape
+    assert b._cfg_hash({"model": "gpt2-125m", "batch": 8,
+                        "zero_stage": 3}, base) != h1
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    assert '"zero_stage": 3' in src, "bench ladder lost its stage-3 rung"
 
 
 def test_cache_roundtrip_and_corruption_tolerance(tmp_path):

@@ -395,6 +395,41 @@ def test_host_sync_fires_in_make_factory_defs():
     assert rule_names(got) == ["host-sync"]
 
 
+HS_PLAN_BUILDER_BAD = """
+class E:
+    def train_batch(self, batch):
+        plan = build_gather_plan(self._names, self._shapes, self._dims, 8)
+        return self._jit(batch, plan)
+"""
+
+HS_PLAN_BUILDER_GOOD = """
+class E:
+    def _arm_stage3(self, stage, dp):
+        self._s3_plan = build_gather_plan(self._names, self._shapes,
+                                          self._dims, dp)
+        if not self._s3_plan.blocks:
+            log_dist("stage-3 DISARMED - nothing partitionable")
+
+    def train_batch(self, batch):
+        return self._jit(batch, self._s3_plan)
+"""
+
+
+def test_host_sync_flags_plan_builder_in_hot_fn():
+    """ISSUE 8 satellite: the stage-3 gather-plan builder (O(param-leaves)
+    host work) is flagged ANYWHERE inside a hot step-driving function —
+    not just in loops — and quiet when built once at arming time."""
+    path = "deepspeed_tpu/runtime/engine.py"
+    got = lint(HS_PLAN_BUILDER_BAD, path, rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"]
+    assert "arming time" in got[0].message
+    assert lint(HS_PLAN_BUILDER_GOOD, path, rules=["host-sync"]) == []
+    # the bar applies to the engine files' hot fns only: a cold caller
+    # (or a non-engine file) builds plans freely
+    assert lint(HS_PLAN_BUILDER_BAD, "tools/somefile.py",
+                rules=["host-sync"]) == []
+
+
 @pytest.mark.parametrize("path", ["deepspeed_tpu/runtime/engine.py",
                                   "deepspeed_tpu/runtime/pipe/engine.py",
                                   "bench.py", "tools/pipe_bench.py",
@@ -557,6 +592,28 @@ def test_disarmed_discipline_quiet_with_warning():
 def test_disarmed_discipline_catches_armed_attr_outside_arm_fns():
     got = lint(DISARM_BAD_ATTR_ONLY, rules=["disarmed-discipline"])
     assert rule_names(got) == ["disarmed-discipline"]
+
+
+DISARM_S3_BAD = """
+class E:
+    def _arm_stage3(self, stage, dp, params_template):
+        self._s3_sched_armed = stage == 3 and dp > 1
+"""
+
+DISARM_S3_GOOD = DISARM_S3_BAD + """
+        if stage == 3 and not self._s3_sched_armed:
+            log_dist("ZeRO stage-3: scheduled gathers DISARMED - dp is 1",
+                     ranks=[0], level=logging.WARNING)
+"""
+
+
+def test_disarmed_discipline_covers_arm_stage3_path():
+    """ISSUE 8 satellite: the new _arm_stage3_* arming path is held to
+    the same discipline — fire without a DISARMED branch, quiet with."""
+    got = lint(DISARM_S3_BAD, rules=["disarmed-discipline"])
+    assert rule_names(got) == ["disarmed-discipline"]
+    assert "_arm_stage3" in got[0].message
+    assert lint(DISARM_S3_GOOD, rules=["disarmed-discipline"]) == []
 
 
 # ---------------------------------------------------------------------------

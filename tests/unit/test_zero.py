@@ -114,8 +114,10 @@ def test_zero_stages_same_trajectory(stage):
 
 def test_zero3_params_sharded_and_parity(eight_devices):
     """ZeRO-3 extension: compute params live sharded over 'data' (1/8 per
-    device) and the trajectory matches stage 0 — XLA's per-use all-gathers
-    are numerically invisible."""
+    device) and the IMPLICIT path's trajectory matches stage 0 — XLA's
+    per-use all-gathers are numerically invisible.  (The default
+    SCHEDULED int8 gathers are deliberately lossy on the wire; their 2%
+    parity bound lives in tests/unit/test_zero_stage3.py.)"""
     import deepspeed_tpu
     from tests.unit.simple_model import SimpleModel
 
@@ -124,7 +126,8 @@ def test_zero3_params_sharded_and_parity(eight_devices):
             model=SimpleModel(hidden_dim=16), config_params={
                 "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
                 "optimizer": {"type": "Adam", "params": {"lr": 0.02}},
-                "zero_optimization": {"stage": stage},
+                "zero_optimization": {"stage": stage,
+                                      "stage3_scheduled_gathers": False},
                 "mesh": {"data": 8}, "steps_per_print": 10 ** 9})
         rng = np.random.default_rng(0)
         x = rng.standard_normal((8, 16)).astype(np.float32)

@@ -67,6 +67,17 @@ CONFIGS = {
         quantized_weights=True),
     "gpt2-350m-ish/dp256/stage2/qgz-hier8": dict(
         shapes=GPT2ISH, dp=256, quantized_gradients=True, intra_size=8),
+    # ZeRO stage-3 parameter gathers (ISSUE 8).  The implicit path lets
+    # XLA gather each partitioned weight at every use site — with a
+    # remat'd backward that is TWO bf16 gathers per micro-step; the
+    # scheduled path gathers ONCE per micro as int8 blocks + fp32
+    # scales (~3.9x less gather wire).  Both are budgeted so neither a
+    # regression to double-gathering nor a dequantized wire can land
+    # silently.
+    "gpt2-350m-ish/dp8/stage3/implicit-bf16-remat": dict(
+        shapes=GPT2ISH, dp=8, param_gathers=2),
+    "gpt2-350m-ish/dp8/stage3/scheduled-int8": dict(
+        shapes=GPT2ISH, dp=8, quantized_weights=True, param_gathers=1),
     "mlp16/dp8/stage2/dense": dict(shapes=MLP16, dp=8,
                                    quantized_gradients=False),
     "mlp16/dp8/stage2/qgz": dict(shapes=MLP16, dp=8,
@@ -135,7 +146,8 @@ def compute_volumes():
             quantized_weights=cfg.get("quantized_weights", False),
             block_size=cfg.get("block_size", 128),
             intra_size=cfg.get("intra_size", 0),
-            param_dtype=cfg.get("param_dtype", "bfloat16"))
+            param_dtype=cfg.get("param_dtype", "bfloat16"),
+            param_gathers_per_step=cfg.get("param_gathers", 1))
         out[name] = {
             "total_bytes_per_step": report["total_bytes_per_step"],
             "grad_exchange_bytes_per_step":

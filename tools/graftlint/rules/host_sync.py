@@ -54,6 +54,13 @@ HOT_FN_RE = re.compile(
 # per iteration pollutes the measured step time with transfer latency
 BENCH_FILES = {"bench.py", "tools/pipe_bench.py", "tools/serve_bench.py"}
 
+# cold-path builders: O(param-leaves) host work (tree flattening, shape
+# math, spec construction) that belongs at arming/compile time.  A call
+# from a hot step-driving function — even outside a loop — rebuilds the
+# plan every step, so it is flagged anywhere inside a hot fn.
+COLD_BUILDER_NAMES = {"build_gather_plan", "_arm_stage3",
+                      "_arm_quantized_collectives", "_build_shardings"}
+
 SYNC_METHOD_ATTRS = {"item", "block_until_ready"}
 SYNC_FN_NAMES = {"device_get", "block_until_ready"}
 NP_MATERIALIZERS = {"asarray", "array"}
@@ -173,6 +180,16 @@ class HostSyncRule(Rule):
                              or HOT_FN_RE.match(n.name)):
                     hot_fns.append(n)
             for fn in hot_fns:
+                # cold-path builders called from a hot fn: the gather
+                # plan / sharding spec would be rebuilt every step
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.Call) \
+                            and call_name(n) in COLD_BUILDER_NAMES:
+                        add(n, f"{call_name(n)}()",
+                            f"called inside hot step path {fn.name}() — "
+                            f"plan/spec builders are O(param-leaves) host "
+                            f"work; build once at arming time and reuse "
+                            f"the cached plan")
                 for n in ast.walk(fn):
                     if not isinstance(n, LOOP_NODES):
                         continue

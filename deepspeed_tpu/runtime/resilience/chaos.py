@@ -25,7 +25,10 @@ class ChaosPlan:
     def __init__(self, kill_after_files=None, kill_at_point=None,
                  corrupt_after_files=None, corrupt_nbytes=4,
                  nan_grad_steps=0, cancel_request_every=0,
-                 preempt_after_steps=0):
+                 preempt_after_steps=0, kill_serving_after_steps=0,
+                 slow_serving_step_every=0, slow_serving_step_s=0.05,
+                 poison_logits_at_step=0, burst_arrival_every=0,
+                 burst_arrival_count=0):
         self.kill_after_files = kill_after_files
         self.kill_at_point = kill_at_point
         self.corrupt_after_files = corrupt_after_files
@@ -33,6 +36,12 @@ class ChaosPlan:
         self.nan_grad_steps = nan_grad_steps
         self.cancel_request_every = cancel_request_every
         self.preempt_after_steps = preempt_after_steps
+        self.kill_serving_after_steps = kill_serving_after_steps
+        self.slow_serving_step_every = slow_serving_step_every
+        self.slow_serving_step_s = slow_serving_step_s
+        self.poison_logits_at_step = poison_logits_at_step
+        self.burst_arrival_every = burst_arrival_every
+        self.burst_arrival_count = burst_arrival_count
         self.files_written = 0
         self.fired = []
         self._lock = threading.Lock()
@@ -61,6 +70,20 @@ def arm(**kwargs):
                          and raises GracefulPreemption.  Combine with
                          kill_at_point to model a hard kill landing
                          MID-preempt-save.
+    kill_serving_after_steps=N  raise ChaosInterrupt MID-DECODE at serving
+                         step N — after the decode dispatch, before any
+                         host bookkeeping or journal commit: the host
+                         crash the request journal must recover from.
+    slow_serving_step_every=N, slow_serving_step_s=S  sleep S seconds in
+                         every Nth serving step (wedged host / slow
+                         device sim; the serving stall detector's food).
+    poison_logits_at_step=N  inject NaN into the YOUNGEST running lane's
+                         embedding at serving step N — its logits go
+                         non-finite and the engine must quarantine that
+                         request without touching its batch peers.
+    burst_arrival_every=N, burst_arrival_count=K  release K extra request
+                         arrivals every Nth serving step (thundering-herd
+                         traffic; drivers query serving_burst()).
     """
     global _plan
     _plan = ChaosPlan(**kwargs)
@@ -130,6 +153,64 @@ def record_serving_cancel(rid):
     if _plan is not None:
         with _plan._lock:
             _plan.fired.append(("cancel_request", rid))
+
+
+def serving_kill_step(step_index):
+    """Kill-mid-decode: raises ChaosInterrupt the first time the serving
+    engine reaches an armed step — called AFTER the decode dispatch and
+    BEFORE host bookkeeping, so the step's tokens are lost exactly like
+    a real host crash (the journal holds state as of the last commit)."""
+    if _plan is None or not _plan.kill_serving_after_steps:
+        return
+    if step_index < _plan.kill_serving_after_steps:
+        return
+    with _plan._lock:
+        if any(kind == "kill_serving" for kind, _ in _plan.fired):
+            return
+        _plan.fired.append(("kill_serving", step_index))
+    raise ChaosInterrupt(
+        f"chaos: killed serving host mid-decode at step {step_index}")
+
+
+def serving_slow_step_s(step_index):
+    """Seconds to stall this serving step (0.0 = no fault armed)."""
+    if _plan is None or not _plan.slow_serving_step_every:
+        return 0.0
+    if step_index % _plan.slow_serving_step_every:
+        return 0.0
+    with _plan._lock:
+        _plan.fired.append(("slow_serving_step", step_index))
+    return _plan.slow_serving_step_s
+
+
+def serving_poison_step(step_index):
+    """True when an armed plan wants NaN injected into one decode lane
+    at this serving step (the engine picks the youngest running request
+    as the deterministic victim and must quarantine it)."""
+    if _plan is None or not _plan.poison_logits_at_step:
+        return False
+    return step_index == _plan.poison_logits_at_step
+
+
+def record_serving_poison(rid):
+    """Audit one ACTUAL poison injection (a victim lane existed)."""
+    if _plan is not None:
+        with _plan._lock:
+            _plan.fired.append(("poison_logits", rid))
+
+
+def serving_burst(step_index):
+    """Extra request arrivals to release at this serving step — traffic
+    drivers (tools/serve_bench.py, tests) query it so thundering-herd
+    bursts run through the same arming/audit machinery as every other
+    fault."""
+    if _plan is None or not _plan.burst_arrival_every:
+        return 0
+    if step_index % _plan.burst_arrival_every:
+        return 0
+    with _plan._lock:
+        _plan.fired.append(("burst_arrival", step_index))
+    return _plan.burst_arrival_count
 
 
 def consume_preempt_step():

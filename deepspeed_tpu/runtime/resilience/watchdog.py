@@ -125,6 +125,28 @@ class TrainingWatchdog:
         self._dispatch(fired)
         return fired
 
+    def observe_serving_step(self, step):
+        """Serving-side analog of :meth:`observe_step`: stall detection
+        only (serving has no loss scale or NaN-loss streaks — poisoned
+        lanes are quarantined per request by the engine itself), with
+        the same dispatch/abort semantics.  The inference engine calls
+        it once per serving step, so a wedged decode dispatch or a
+        chaos ``slow_serving_step`` trips the same stall machinery the
+        training loop uses."""
+        now = self._clock()
+        fired = []
+        if self.stall_timeout > 0 and self.last_progress_time is not None \
+                and now - self.last_progress_time > self.stall_timeout:
+            fired.append(WatchdogEvent(
+                EVENT_STALL, step,
+                f"serving step {step} took "
+                f"{now - self.last_progress_time:.1f}s "
+                f"(stall_timeout={self.stall_timeout:g}s)",
+                {"elapsed": now - self.last_progress_time}))
+        self.last_progress_time = now
+        self._dispatch(fired)
+        return fired
+
     def check_stall(self, step):
         """Poll for a stall without observing a step (e.g. from a monitor
         loop while train_batch blocks on a hung collective)."""

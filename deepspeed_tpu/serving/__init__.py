@@ -8,13 +8,19 @@
 - :mod:`engine` — :class:`InferenceEngine`: ONE fixed-shape batched
   decode jit with slot masking (requests joining/leaving never
   recompile) plus length-bucketed prefill jits;
-- :mod:`metrics` — TTFT / TPOT / throughput / KV-pool occupancy,
-  exposed via ``InferenceEngine.serving_report()``.
+- :mod:`metrics` — TTFT / TPOT / throughput / goodput / KV-pool
+  occupancy, exposed via ``InferenceEngine.serving_report()``;
+- :mod:`reliability` — deadlines/work budgets, SLO-aware admission and
+  load shedding, graceful drain, the crash-recovery request journal,
+  and per-request poison quarantine.
 """
 from deepspeed_tpu.serving.engine import InferenceEngine
 from deepspeed_tpu.serving.kv_cache import PagedKVPool
 from deepspeed_tpu.serving.metrics import CompilationCounter, ServingMetrics
+from deepspeed_tpu.serving.reliability import (ReliabilityConfig,
+                                               RequestJournal)
 from deepspeed_tpu.serving.scheduler import Request, Scheduler
 
 __all__ = ["InferenceEngine", "PagedKVPool", "Scheduler", "Request",
-           "ServingMetrics", "CompilationCounter"]
+           "ServingMetrics", "CompilationCounter", "ReliabilityConfig",
+           "RequestJournal"]

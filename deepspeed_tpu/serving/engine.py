@@ -29,6 +29,17 @@ on the same mesh axis, params replicated.  Every decode operator is
 batch-uniform in the slot dimension, so the compiled program contains NO
 collectives (runtime/comm_accounting.serving_decode_collectives prices
 this placement against the tensor-parallel alternative).
+
+Reliability (serving/reliability.py): per-request deadlines and work
+budgets enforced at step boundaries, an SLO-aware predicted-TTFT
+admission gate with lowest-priority-first load shedding, graceful
+``drain()`` (SIGTERM via ``install_preemption_handler``), a per-step
+request journal driving ``recover()`` (bit-identical greedy
+continuations after a host crash), and per-request poison quarantine —
+non-finite logits abort only the offending lane, detected on the same
+batched fetch as the sampled tokens.  None of it touches the compiled
+surface's contracts: still ONE decode jit, zero recompiles, zero
+collectives.
 """
 import functools
 import itertools
@@ -45,11 +56,18 @@ from deepspeed_tpu.models.generation import (_attn_core, _block_params,
                                              _ln, _sample, _split_heads)
 from deepspeed_tpu.runtime.quantization import (dequantize_rows,
                                                 quantize_rows)
+from deepspeed_tpu.runtime.resilience import chaos
 from deepspeed_tpu.serving.kv_cache import (TRASH_BLOCK, PagedKVPool,
                                             PoolTensors)
 from deepspeed_tpu.serving.metrics import ServingMetrics
-from deepspeed_tpu.serving.scheduler import Request, Scheduler
+from deepspeed_tpu.serving.reliability import (ABORT_BUDGET, ABORT_EXPIRED,
+                                               ABORT_POISONED, ABORT_SHED,
+                                               Reliability, ReliabilityConfig,
+                                               RequestJournal)
+from deepspeed_tpu.serving.scheduler import (Request, RequestState,
+                                             Scheduler)
 from deepspeed_tpu.utils.jax_compat import ensure_compat
+from deepspeed_tpu.utils.logging import logger
 
 ensure_compat()
 
@@ -97,13 +115,24 @@ def _pool_view(pool, scales, l, tables, quantized, out_dtype):
                            out_dtype).reshape(B, H, W * bs, D)
 
 
-def _paged_forward(params, cfg, pools, tables, pos, blk, off, x,
+def _paged_forward(params, cfg, pools, tables, pos, maxpos, blk, off, x,
                    quantized):
     """Shared transformer pass of decode and chunked prefill: per layer,
     write this step's K/V rows into the pool, gather the page view, and
     run the SAME attention core the contiguous cache uses.  x: (B, T, E)
     with T == number of query tokens per lane; pos: (B*T?,) absolute
-    positions of the query tokens, flattened to match blk/off."""
+    positions of the query tokens, flattened to match blk/off.
+
+    ``maxpos``: (B,) last VALID absolute position per lane.  View
+    positions beyond it have their VALUES zeroed before the attention
+    einsum: their softmax weight is already exactly 0 (the -1e30 score
+    mask), but ``0 * NaN = NaN`` — without the value mask, stale
+    non-finite garbage in a reused/trash block (a quarantined request's
+    poisoned writes) would leak into every lane that merely gathers the
+    block at a masked position.  For finite garbage the zeroing is
+    bit-neutral (0 * garbage was already exactly +/-0), so the parity
+    contract is untouched while per-request fault ISOLATION becomes
+    unconditional."""
     pk, pv, ksc, vsc = pools
     B, T, _ = x.shape
     H, D = cfg.n_head, cfg.head_dim
@@ -111,6 +140,8 @@ def _paged_forward(params, cfg, pools, tables, pos, blk, off, x,
     bs = pk.shape[3]
     validj = (jnp.arange(W * bs)[None, :] <= pos.reshape(B, T)[:, :, None]) \
         .reshape(B, T, W * bs)[:, None]                  # (B, 1, T, K)
+    validk = (jnp.arange(W * bs)[None, :] <= maxpos[:, None]) \
+        [:, None, :, None]                               # (B, 1, K, 1)
     for l, bp in enumerate(_block_params(params, cfg)):
         h = _ln(x, bp["ln_1"], cfg.layer_norm_epsilon)
         qkv = _dense(h, bp["attn"]["c_attn"])
@@ -122,6 +153,8 @@ def _paged_forward(params, cfg, pools, tables, pos, blk, off, x,
         pv, vsc = _pool_write(pv, vsc, l, blk, off, vt, quantized)
         kview = _pool_view(pk, ksc, l, tables, quantized, x.dtype)
         vview = _pool_view(pv, vsc, l, tables, quantized, x.dtype)
+        kview = jnp.where(validk, kview, 0)
+        vview = jnp.where(validk, vview, 0)
         a = _attn_core(q, kview, vview, validj, bp["attn"], x.dtype)
         x = x + a
         x = x + _ffn(_ln(x, bp["ln_2"], cfg.layer_norm_epsilon), bp, cfg)
@@ -161,27 +194,36 @@ def _shard_wrap(core, mesh, axis_name, n_pool, in_streams, n_out_streams):
 @functools.lru_cache(maxsize=64)
 def _make_decode_step(cfg, W, bs, quantized, temperature, top_k, top_p,
                       mesh, axis_name):
-    """ONE fixed-shape decode program over every (local) slot lane."""
+    """ONE fixed-shape decode program over every (local) slot lane.
+
+    ``poison`` is a per-lane additive fault-injection stream (0.0 in
+    production — bit-neutral on the embedding sum): chaos writes NaN
+    into one lane to model a numeric blow-up, and the per-lane
+    ``finite`` output (non-finite logits detector) rides the same
+    batched fetch as the sampled tokens — per-request quarantine costs
+    zero extra host syncs and zero recompiles."""
     def run(params, *args):
-        pools, (tables, pos, tok, active, seeds) = \
-            (args[:4] if quantized else args[:2] + (None, None)), args[-5:]
+        pools, (tables, pos, tok, active, seeds, poison) = \
+            (args[:4] if quantized else args[:2] + (None, None)), args[-6:]
         S = tok.shape[0]
         x = params["wte"].astype(cfg.dtype)[tok][:, None, :] \
             + params["wpe"].astype(cfg.dtype)[pos][:, None, :]   # (S, 1, E)
+        x = x + poison.astype(cfg.dtype)[:, None, None]
         blk = jnp.where(active, tables[jnp.arange(S), pos // bs],
                         TRASH_BLOCK)
         off = pos % bs
-        x, pools = _paged_forward(params, cfg, pools, tables, pos, blk,
-                                  off, x, quantized)
+        x, pools = _paged_forward(params, cfg, pools, tables, pos, pos,
+                                  blk, off, x, quantized)
         logits = _lm_logits(params, cfg, x[:, 0])
+        finite = jnp.isfinite(logits).all(axis=-1)
         nxt = _pick_next(logits, seeds, pos, temperature, top_k, top_p)
         nxt = jnp.where(active, nxt, 0).astype(jnp.int32)
         out = pools[:4] if quantized else pools[:2]
-        return (*out, nxt)
+        return (*out, nxt, finite)
 
     n_pool = 4 if quantized else 2
     return _shard_wrap(run, mesh, axis_name, n_pool,
-                       in_streams=(True,) * 5, n_out_streams=1)
+                       in_streams=(True,) * 6, n_out_streams=2)
 
 
 @functools.lru_cache(maxsize=256)
@@ -203,22 +245,24 @@ def _make_prefill_chunk(cfg, C, W, bs, quantized, final, temperature,
         valid_i = jnp.arange(C) < n_valid
         blk = jnp.where(valid_i, row[posns // bs], TRASH_BLOCK)
         off = posns % bs
+        maxpos = (start + n_valid - 1)[None]             # (1,)
         x, pools = _paged_forward(params, cfg, pools, row[None], posns,
-                                  blk, off, x, quantized)
+                                  maxpos, blk, off, x, quantized)
         out = pools[:4] if quantized else pools[:2]
         if not final:
             return out
         xe = jax.lax.dynamic_index_in_dim(x[0], n_valid - 1, 0,
                                           keepdims=False)
         logits = _lm_logits(params, cfg, xe[None])
+        finite = jnp.isfinite(logits).all(axis=-1)       # (1,)
         nxt = _pick_next(logits, seed[None], (start + n_valid - 1)[None],
                          temperature, top_k, top_p)
-        return (*out, nxt)
+        return (*out, nxt, finite)
 
     n_pool = 4 if quantized else 2
     return _shard_wrap(run, mesh, axis_name, n_pool,
                        in_streams=(True, False, False, True, False),
-                       n_out_streams=1 if final else 0)
+                       n_out_streams=2 if final else 0)
 
 
 class InferenceEngine:
@@ -233,7 +277,8 @@ class InferenceEngine:
                  kv_blocks=None, max_blocks_per_seq=None, prefill_chunk=16,
                  quantize_kv=False, temperature=0.0, top_k=0, top_p=0.0,
                  policy="continuous", shards=1, mesh=None,
-                 axis_name="data", watchdog=None, clock=time.monotonic):
+                 axis_name="data", watchdog=None, clock=time.monotonic,
+                 reliability=None):
         cfg = model.config
         assert not getattr(cfg, "moe_num_experts", 0), \
             "InferenceEngine serves dense blocks only: chunked prefill " \
@@ -272,18 +317,25 @@ class InferenceEngine:
         # instead of piling evictions onto shard 0
         self.scheduler.slot_ranker = \
             lambda s: self.pool.free_blocks(self._shard_for_slot(s))
+        self.clock = clock
         self.metrics = ServingMetrics(clock)
         self.results = {}
         self._watchdog = watchdog
         self._last_metrics = {}
         self._step_idx = 0
         self._rids = itertools.count()
+        self._warming = False
+        self._drain_requested = False
+        rel_cfg = reliability if isinstance(reliability, ReliabilityConfig) \
+            else ReliabilityConfig(**(reliability or {}))
+        self.reliability = Reliability(self, rel_cfg)
         S = self.max_slots
         self._tables = np.full((S, self.W), TRASH_BLOCK, np.int32)
         self._pos = np.zeros(S, np.int32)
         self._tok = np.zeros(S, np.int32)
         self._active = np.zeros(S, bool)
         self._seeds = np.zeros(S, np.int32)
+        self._poison = np.zeros(S, np.float32)
         self._decode = _make_decode_step(
             cfg, self.W, self.bs, self.pool.quantized, self.temperature,
             self.top_k, self.top_p, mesh, axis_name)
@@ -298,7 +350,15 @@ class InferenceEngine:
                    (self.pool.blocks_per_shard - 1) * self.bs)
 
     def submit(self, prompt, max_new_tokens, *, priority=0,
-               eos_token_id=None, seed=0) -> int:
+               eos_token_id=None, seed=0, deadline_s=None,
+               work_budget=None, _generated=None, _rid=None) -> int:
+        """Submit one request.  ``deadline_s``/``work_budget`` (engine
+        defaults from the ReliabilityConfig) bound its wall-clock life
+        and total scheduled token-writes; under predicted SLO overload
+        the admission gate may shed lower-priority queued work or turn
+        this request away (``results[rid]["status"] == "shed"``).
+        ``_generated``/``_rid`` are the :meth:`recover` re-submission
+        hooks (journal replay through the eviction re-prefill path)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         assert prompt.size >= 1 and max_new_tokens >= 1
         total = prompt.size + int(max_new_tokens)
@@ -306,13 +366,34 @@ class InferenceEngine:
             f"prompt+max_new={total} exceeds per-sequence capacity " \
             f"{self.capacity_per_seq} (W={self.W} blocks x {self.bs}, " \
             f"{self.pool.blocks_per_shard - 1} usable blocks/shard)"
-        rid = next(self._rids)
+        rel_cfg = self.reliability.config
+        if self._warming:
+            deadline_s = work_budget = None   # synthetic warmup traffic
+        else:
+            if deadline_s is None:
+                deadline_s = rel_cfg.default_deadline_s
+            if work_budget is None:
+                work_budget = rel_cfg.default_work_budget
+        rid = next(self._rids) if _rid is None else int(_rid)
         req = Request(rid=rid, prompt=prompt,
                       max_new_tokens=int(max_new_tokens),
                       priority=int(priority), eos_token_id=eos_token_id,
-                      seed=int(seed))
-        self.scheduler.submit(req)
+                      seed=int(seed), deadline_s=deadline_s,
+                      work_budget=work_budget)
+        if deadline_s is not None:
+            req.deadline = self.clock() + float(deadline_s)
+        if _generated:
+            req.generated = [int(t) for t in _generated]
         self.metrics.record_submit(rid)
+        if not self._warming:
+            if self.reliability.on_submit(req) == "reject":
+                self.results[rid] = {
+                    "tokens": np.asarray(req.full_tokens, np.int32),
+                    "status": ABORT_SHED, "evictions": 0,
+                }
+                self.metrics.record_finish(rid, ABORT_SHED)
+                return rid
+        self.scheduler.submit(req)
         return rid
 
     def cancel(self, rid) -> bool:
@@ -323,38 +404,55 @@ class InferenceEngine:
         return True
 
     def step(self) -> dict:
-        """One serving tick: chaos hooks, at most one prefill chunk, one
-        batched decode dispatch, then host-side bookkeeping on a SINGLE
-        batched token fetch."""
+        """One serving tick: chaos hooks, deadline/budget enforcement,
+        at most one prefill chunk, one batched decode dispatch, then
+        host-side bookkeeping on a SINGLE batched token+finiteness
+        fetch, and the journal's step-boundary commit."""
         self._step_idx += 1
+        slow = chaos.serving_slow_step_s(self._step_idx)
+        if slow:
+            time.sleep(slow)
         if self._watchdog is not None:
-            self._watchdog.heartbeat()
+            self._watchdog.observe_serving_step(self._step_idx)
+        if self._drain_requested:
+            self.scheduler.draining = True
         events = {"admitted": [], "finished": [], "evicted": [],
-                  "cancelled": []}
+                  "cancelled": [], "expired": [], "budget": [],
+                  "poisoned": []}
         rid = self.scheduler.chaos_cancel()
         if rid is not None and self.cancel(rid):
             events["cancelled"].append(rid)
+        self._enforce_deadlines(events)
         self._prefill_tick(events)
         decoded = self._decode_tick(events)
         self.scheduler.on_drained()
+        self.reliability.on_step_end()
         occ = self.pool.occupancy()
         frag = self.pool.fragmentation()
         qd = self.scheduler.queue_depth()
         self.metrics.record_step(
             queue_depth=qd, running=decoded, slots=self.max_slots,
             occupancy=occ, fragmentation=frag, decoded=decoded > 0)
+        rel = self.reliability
         self._last_metrics = {
             "step": self._step_idx, "queue_depth": qd,
             "running": len(self.scheduler.running),
             "kv_occupancy": occ, "kv_fragmentation": frag,
             "decoded_lanes": decoded,
             "events": {k: len(v) for k, v in events.items()},
+            "shed": rel.aborts[ABORT_SHED],
+            "expired": rel.aborts[ABORT_EXPIRED],
+            "poisoned": rel.aborts[ABORT_POISONED],
+            "journal_depth": rel.journal_depth(),
+            "draining": self.scheduler.draining,
         }
         return events
 
     def serve(self, *, max_steps=100000) -> dict:
         steps = 0
         while self.scheduler.has_work():
+            if self._drain_requested and not self.scheduler.in_flight():
+                break    # drained: waiting work stays journaled
             if steps >= max_steps:
                 raise RuntimeError(
                     f"serve() exceeded max_steps={max_steps} with "
@@ -362,6 +460,79 @@ class InferenceEngine:
             self.step()
             steps += 1
         return self.results
+
+    # -- reliability lifecycle (drain / recover) ------------------------
+    def request_drain(self) -> None:
+        """Ask for a graceful drain: admission stops at the next step
+        boundary, in-flight requests run to completion, queued requests
+        stay journaled for a successor's :meth:`recover`.  Signal-
+        handler safe: only sets a flag (the PR 7
+        ``request_preemption`` idiom)."""
+        self._drain_requested = True
+
+    def install_preemption_handler(self, signals=None) -> None:
+        """Route SIGTERM (the preemption notice on TPU pods) into
+        :meth:`request_drain` — the serving analog of the training
+        engine's ``install_preemption_handler``.  Main thread only (a
+        Python signal-handler constraint)."""
+        import signal as signal_mod
+
+        sigs = tuple(signals) if signals else (signal_mod.SIGTERM,)
+        for s in sigs:
+            signal_mod.signal(s, lambda *_a: self.request_drain())
+        logger.info("serving preemption handler installed for %s",
+                    [signal_mod.Signals(s).name for s in sigs])
+
+    def drain(self, *, max_steps=100000) -> dict:
+        """Graceful shutdown: stop admission, finish every in-flight
+        request (deadlines still enforced — a hung request cannot stall
+        the drain past its budget), commit the journal, and return the
+        results so far.  Queued requests stay live in the journal; a
+        replacement engine picks them up via :meth:`recover`."""
+        self.request_drain()
+        self.scheduler.draining = True
+        steps = 0
+        while self.scheduler.in_flight():
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"drain() exceeded max_steps={max_steps} with "
+                    f"{len(self.scheduler.running)} still running")
+            self.step()
+            steps += 1
+        self.reliability.on_step_end()
+        left = self.scheduler.queue_depth()
+        if left and self.reliability.journal is None:
+            logger.warning(
+                "drain: %d queued requests have NO journal armed "
+                "(ReliabilityConfig.journal_path unset) — they are lost "
+                "on exit instead of recoverable.", left)
+        return self.results
+
+    def recover(self, journal_path) -> list:
+        """Crash recovery: replay a (dead predecessor's) request journal
+        and re-submit every live request — with its journaled generated
+        tokens — through the SAME re-prefill path eviction uses, so
+        greedy continuations are bit-identical to the uninterrupted
+        run.  Original rids and FCFS order are preserved; deadlines
+        restart (wall clocks do not survive processes; the journal
+        stores the relative budget).  Returns the recovered rids."""
+        assert not self.scheduler.has_work(), "recover() on a busy engine"
+        entries = RequestJournal.replay(journal_path)
+        rids = []
+        max_rid = -1
+        for e in entries:
+            rid = self.submit(
+                np.asarray(e["prompt"], np.int32),
+                e["max_new"], priority=e["priority"],
+                eos_token_id=e["eos"], seed=e["seed"],
+                deadline_s=e["deadline_s"], work_budget=e["work_budget"],
+                _generated=e["generated"], _rid=e["rid"])
+            rids.append(rid)
+            max_rid = max(max_rid, rid)
+        self._rids = itertools.count(max_rid + 1)
+        logger.info("recover: re-submitted %d journaled requests from %s",
+                    len(rids), journal_path)
+        return rids
 
     def warmup(self) -> None:
         """Compile every program the steady state can need — the decode
@@ -377,6 +548,9 @@ class InferenceEngine:
         short prompt per bucket plus ONE prompt longer than
         prefill_chunk (iff any admissible prompt is) covers everything."""
         assert not self.scheduler.has_work(), "warmup on a busy engine"
+        # warmup traffic is synthetic: bypass the admission gate and the
+        # journal (a recovery replay must never see throwaway requests)
+        self._warming = True
         cap = self.capacity_per_seq
         lens = set()
         for b in self._buckets():
@@ -397,6 +571,7 @@ class InferenceEngine:
             # max_new=1
             self.submit(np.zeros(1, np.int32), max_new_tokens=2)
         self.serve()
+        self._warming = False
         self.results.clear()
         self.metrics.reset()
         self._last_metrics = {}
@@ -422,13 +597,15 @@ class InferenceEngine:
             "top_p": self.top_p,
         }
         rep["kv_pool"]["now"] = self.pool.stats()
+        rep["reliability"] = self.reliability.report()
         return rep
 
     def decode_hlo(self) -> str:
         """Compiled HLO of the decode program (for the graftlint HLO
         contracts: host-transfer-free, pool donated, zero collectives)."""
         args = (self.params, *self.pool.tensors.arrays, self._tables,
-                self._pos, self._tok, self._active, self._seeds)
+                self._pos, self._tok, self._active, self._seeds,
+                self._poison)
         return self._decode.lower(*args).compile().as_text()
 
     def n_pool_tensors(self) -> int:
@@ -500,6 +677,33 @@ class InferenceEngine:
             "status": reason, "evictions": req.evictions,
         }
         self.metrics.record_finish(req.rid, reason)
+        if not self._warming:
+            self.reliability.on_finish(req, reason)
+
+    def _abort(self, req, reason, events=None):
+        """Terminal non-completion in ANY live state (waiting, prefill,
+        running): scheduler bookkeeping, KV blocks freed, slot scrubbed,
+        result recorded with the explicit reason — an expired/poisoned
+        request can never wedge the shared decode batch."""
+        self.scheduler.finish(req, reason)
+        self._cleanup(req, reason)
+        if events is not None and reason in events:
+            events[reason].append(req.rid)
+
+    def _enforce_deadlines(self, events):
+        """Step-boundary deadline + work-budget enforcement over every
+        live request.  Pure host accounting (the clock and two ints per
+        request) — no device syncs, held to the hot-path lint bar."""
+        now = self.clock()
+        for req in list(self.scheduler.requests.values()):
+            if req.state in (RequestState.FINISHED,
+                             RequestState.CANCELLED):
+                continue
+            if req.deadline is not None and now > req.deadline:
+                self._abort(req, ABORT_EXPIRED, events)
+            elif req.work_budget is not None \
+                    and req.work_done >= req.work_budget:
+                self._abort(req, ABORT_BUDGET, events)
 
     def _finish(self, req, reason, events):
         self.scheduler.finish(req, reason)
@@ -509,6 +713,8 @@ class InferenceEngine:
     def _on_new_token(self, req, token, events, *, promote):
         req.generated.append(int(token))
         self.metrics.record_token(req.rid)
+        if not self._warming:
+            self.reliability.on_token(req, int(token))
         if req.done:
             self._finish(req, "finished", events)
             return
@@ -556,12 +762,18 @@ class InferenceEngine:
         rows, nv = self._prefill_args(req, n)
         out = fn(self.params, *self.pool.tensors.arrays, rows, tok_pad,
                  np.int32(start), nv, np.int32(req.seed))
+        req.work_done += n
         if final:
-            nxt = out[-1]
-            self._rebind(out[:-1])
-            first = int(np.asarray(
-                jax.device_get(nxt)).reshape(-1)[req.shard])
+            # ONE batched fetch: the sampled token and the non-finite-
+            # logits detector travel together (no extra host sync)
+            fetched = jax.device_get((out[-2], out[-1]))
+            self._rebind(out[:-2])
+            first = int(np.asarray(fetched[0]).reshape(-1)[req.shard])
+            ok = bool(np.asarray(fetched[1]).reshape(-1)[req.shard])
             req.prefill_done = total
+            if not ok:
+                self._abort(req, ABORT_POISONED, events)
+                return
             self._on_new_token(req, first, events, promote=True)
         else:
             self._rebind(out)
@@ -583,15 +795,40 @@ class InferenceEngine:
         running = dict(sch.running)
         if not running:
             return 0
+        # chaos poison: NaN into the youngest DISPATCHED lane's embedding
+        # (chosen after the growth loop so an evicted lane is never the
+        # victim) — its logits go non-finite and must be quarantined
+        if chaos.serving_poison_step(self._step_idx):
+            victim = max(running.values(), key=lambda r: r.submit_seq)
+            self._poison[victim.slot] = np.nan
+            chaos.record_serving_poison(victim.rid)
         for slot, req in running.items():
             self._tables[slot] = self.pool.table_row(req.rid, self.W)
+            req.work_done += 1
         out = self._decode(self.params, *self.pool.tensors.arrays,
                            self._tables, self._pos, self._tok,
-                           self._active, self._seeds)
-        nxt = out[-1]
-        self._rebind(out[:-1])
-        toks = np.asarray(jax.device_get(nxt))
+                           self._active, self._seeds, self._poison)
+        self._rebind(out[:-2])
+        # kill-mid-decode chaos: the dispatch happened, NO host
+        # bookkeeping has — the journal holds the last committed step
+        chaos.serving_kill_step(self._step_idx)
+        # ONE batched fetch per step: sampled tokens + per-lane
+        # finiteness (the poison detector) travel together
+        toks, fins = jax.device_get((out[-2], out[-1]))
+        toks = np.asarray(toks)
+        fins = np.asarray(fins)
+        # one-step injection, reset only AFTER the fetch: the CPU
+        # backend may alias numpy inputs zero-copy, so host mutation
+        # must wait for the execution to complete (same discipline as
+        # _pos/_tok below)
+        self._poison[:] = 0.0
         for slot, req in running.items():
+            if not fins[slot]:
+                # per-request fault isolation: quarantine THIS request;
+                # its blocks are freed and the value mask keeps any NaN
+                # it wrote from ever reaching another lane's einsum
+                self._abort(req, ABORT_POISONED, events)
+                continue
             self._pos[slot] += 1
             self._tok[slot] = int(toks[slot])
             self._on_new_token(req, int(toks[slot]), events,

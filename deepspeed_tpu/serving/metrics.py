@@ -60,10 +60,15 @@ def _mean(xs):
 
 
 def _pct(xs, q):
+    """Nearest-rank percentile, total over its edge cases: empty input
+    is ``None`` (never raises), a single sample IS every percentile,
+    and q is clamped to [0, 1] — the overload guard reads p50/p95 off
+    arbitrary slices of a run, including before the first token."""
     if not xs:
         return None
     s = sorted(xs)
-    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
+    q = min(1.0, max(0.0, q))
+    return s[min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))]
 
 
 class ServingMetrics:
@@ -82,17 +87,22 @@ class ServingMetrics:
         self.completed = 0
         self.cancelled = 0
         self.evictions = 0
+        # reliability-layer abort counters, keyed by abort reason
+        # (expired / budget / shed / poisoned)
+        self.aborted: Dict[str, int] = {}
         self.steps = 0
         self.decode_steps = 0
         self.slot_steps = 0            # decode lanes dispatched (incl. idle)
         self.active_slot_steps = 0     # decode lanes carrying a request
         self.total_tokens = 0          # generated tokens, all requests
         self.useful_tokens = 0         # tokens of requests that FINISHED
+        self.wasted_tokens = 0         # tokens of aborted/shed/cancelled reqs
         self._queue_depth: List[int] = []
         self._occupancy: List[float] = []
         self._fragmentation: List[float] = []
         self._t0 = None
         self._t_end = None
+        self._step_dt_ema = None       # EMA of inter-step wall time
 
     # -- request lifecycle ---------------------------------------------
     def record_submit(self, rid):
@@ -109,11 +119,19 @@ class ServingMetrics:
         self.total_tokens += 1
 
     def record_finish(self, rid, reason="finished"):
+        """Terminal accounting.  Only ``finished`` tokens count toward
+        goodput — everything a cancelled/expired/shed/poisoned request
+        generated was work the engine cannot bill, and the overload
+        guard needs that honest denominator."""
+        if reason == "finished":
+            self.completed += 1
+            self.useful_tokens += self._tokens.get(rid, 0)
+            return
+        self.wasted_tokens += self._tokens.get(rid, 0)
         if reason == "cancelled":
             self.cancelled += 1
         else:
-            self.completed += 1
-            self.useful_tokens += self._tokens.get(rid, 0)
+            self.aborted[reason] = self.aborted.get(reason, 0) + 1
 
     def record_eviction(self, rid):
         self.evictions += 1
@@ -124,6 +142,10 @@ class ServingMetrics:
         now = self._clock()
         if self._t0 is None:
             self._t0 = now
+        elif self._t_end is not None:
+            dt = now - self._t_end
+            self._step_dt_ema = dt if self._step_dt_ema is None \
+                else 0.8 * self._step_dt_ema + 0.2 * dt
         self._t_end = now
         self.steps += 1
         if decoded:
@@ -135,6 +157,12 @@ class ServingMetrics:
         self._fragmentation.append(fragmentation)
 
     # -- summary --------------------------------------------------------
+    def step_time(self):
+        """EMA of the wall time between consecutive serving steps — the
+        admission gate's measured-TPOT proxy (one decode step emits one
+        token per running lane).  None before two steps completed."""
+        return self._step_dt_ema
+
     def tpot(self):
         """Mean time-per-output-token over requests with >= 2 tokens."""
         spans, counts = 0.0, 0
@@ -151,13 +179,15 @@ class ServingMetrics:
                 "completed": self.completed,
                 "cancelled": self.cancelled,
                 "evictions": self.evictions,
+                "aborted": dict(self.aborted),
             },
             "ttft_s": {"mean": _mean(self.ttft), "p50": _pct(self.ttft, .5),
                        "p95": _pct(self.ttft, .95),
                        "max": max(self.ttft) if self.ttft else None},
             "tpot_s": self.tpot(),
             "tokens": {"generated": self.total_tokens,
-                       "useful": self.useful_tokens},
+                       "useful": self.useful_tokens,
+                       "wasted": self.wasted_tokens},
             "throughput": {
                 "wall_s": wall,
                 "tokens_per_s": (self.total_tokens / wall) if wall > 0
@@ -167,6 +197,15 @@ class ServingMetrics:
                 # dispatch carried a live request)
                 "tokens_per_slot_step": (self.total_tokens / self.slot_steps)
                 if self.slot_steps else None,
+                # GOODPUT: only finished requests' tokens over the same
+                # denominator — what the overload guard compares against
+                # the steady-state baseline (shed/expired work is not
+                # throughput, it is waste)
+                "goodput_tokens_per_slot_step":
+                    (self.useful_tokens / self.slot_steps)
+                    if self.slot_steps else None,
+                "useful_fraction": (self.useful_tokens / self.total_tokens)
+                if self.total_tokens else None,
                 "slot_utilization": (self.active_slot_steps / self.slot_steps)
                 if self.slot_steps else None,
             },

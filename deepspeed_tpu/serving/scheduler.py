@@ -61,6 +61,10 @@ class Request:
     priority: int = 0                  # lower = more important
     eos_token_id: Optional[int] = None
     seed: int = 0
+    # -- reliability (deepspeed_tpu/serving/reliability.py) -------------
+    deadline_s: Optional[float] = None   # relative budget (journaled)
+    deadline: Optional[float] = None     # absolute, in the engine's clock
+    work_budget: Optional[int] = None    # max scheduled token-writes
     # -- dynamic state --------------------------------------------------
     state: RequestState = RequestState.WAITING
     generated: List[int] = field(default_factory=list)
@@ -69,6 +73,7 @@ class Request:
     shard: int = 0
     submit_seq: int = -1
     evictions: int = 0
+    work_done: int = 0                 # token-writes scheduled so far
     finish_reason: Optional[str] = None
 
     @property
@@ -109,6 +114,10 @@ class Scheduler:
         self._gate_open = True
         self._batch_left = self.max_slots
         self.chaos_step = 0
+        # graceful drain (engine.request_drain / SIGTERM): admission
+        # stops, in-flight work runs to completion, waiting requests
+        # stay journaled for a successor's recover()
+        self.draining = False
 
     # -- queue ----------------------------------------------------------
     def submit(self, req: Request) -> None:
@@ -146,9 +155,30 @@ class Scheduler:
         return sum(1 for r in self.requests.values()
                    if r.state is RequestState.WAITING)
 
+    def waiting(self) -> List[Request]:
+        """Every WAITING request (shed-victim selection + the admission
+        gate's queue accounting)."""
+        return [r for r in self.requests.values()
+                if r.state is RequestState.WAITING]
+
+    def queued_prefill_tokens(self) -> int:
+        """Prefill tokens the engine still owes the queue: every waiting
+        request's known tokens plus the in-flight prefill's remainder —
+        the numerator of the predicted-TTFT admission model."""
+        toks = sum(len(r.full_tokens) for r in self.requests.values()
+                   if r.state is RequestState.WAITING)
+        if self.prefilling is not None:
+            toks += len(self.prefilling.full_tokens) \
+                - self.prefilling.prefill_done
+        return toks
+
     def has_work(self) -> bool:
         return bool(self.running) or self.prefilling is not None \
             or self.queue_depth() > 0
+
+    def in_flight(self) -> bool:
+        """Admitted work only (what a graceful drain must finish)."""
+        return bool(self.running) or self.prefilling is not None
 
     # -- slots ----------------------------------------------------------
     # the engine installs a ranker so admission steers toward the slot
@@ -168,6 +198,8 @@ class Scheduler:
         return max(free, key=lambda s: (self.slot_ranker(s), -s))
 
     def may_admit(self) -> bool:
+        if self.draining:
+            return False
         if self.policy == "continuous":
             return True
         return self._gate_open
@@ -255,8 +287,11 @@ class Scheduler:
             del self.running[req.slot]
         if req is self.prefilling:
             self.prefilling = None
-        req.state = RequestState.CANCELLED if reason == "cancelled" \
-            else RequestState.FINISHED
+        # every terminal-without-completing reason (cancelled, and the
+        # reliability layer's expired/budget/shed/poisoned) lands in the
+        # CANCELLED state; only "finished" means the request completed
+        req.state = RequestState.FINISHED if reason == "finished" \
+            else RequestState.CANCELLED
         req.finish_reason = reason
         # req.slot is deliberately NOT cleared: the engine still needs it
         # to scrub the slot's host arrays (active mask, page-table row)

@@ -469,6 +469,78 @@ def test_host_sync_serving_per_token_fetch_is_an_error(path):
     assert lint(HS_SERVING_GOOD, path, rules=["host-sync"]) == []
 
 
+HS_RELIABILITY_BAD = """
+class InferenceEngine:
+    def _enforce_deadlines(self, events):
+        now = self.clock()
+        for req in list(self.scheduler.requests.values()):
+            if float(jax.device_get(req.deadline_arr)) < now:
+                self._abort(req, "expired", events)
+"""
+
+HS_RELIABILITY_GOOD = """
+class InferenceEngine:
+    def _enforce_deadlines(self, events):
+        now = self.clock()
+        for req in list(self.scheduler.requests.values()):
+            if req.deadline is not None and now > req.deadline:
+                self._abort(req, "expired", events)
+
+    def recover(self, journal_path):
+        entries = RequestJournal.replay(journal_path)
+        return [self.submit(e["prompt"], e["max_new"]) for e in entries]
+
+    def drain(self):
+        while self.scheduler.in_flight():
+            self.step()
+        return self.results
+"""
+
+HS_RECOVER_BAD = """
+class InferenceEngine:
+    def recover(self, journal_path):
+        rids = []
+        for e in RequestJournal.replay(journal_path):
+            rids.append(self.submit(e["prompt"], e["max_new"]))
+            jax.device_get(self.pool.tensors.k)
+        return rids
+"""
+
+HS_DRAIN_BAD = """
+class InferenceEngine:
+    def drain(self):
+        while self.scheduler.in_flight():
+            self.step()
+            self.pool.tensors.k.block_until_ready()
+        return self.results
+"""
+
+
+@pytest.mark.parametrize("src,label", [
+    (HS_RELIABILITY_BAD, "_enforce_deadlines"),
+    (HS_RECOVER_BAD, "recover"),
+    (HS_DRAIN_BAD, "drain"),
+])
+@pytest.mark.parametrize("path", ["deepspeed_tpu/serving/engine.py",
+                                  "deepspeed_tpu/serving/reliability.py"])
+def test_host_sync_covers_serving_reliability_hot_fns(src, label, path):
+    """ISSUE 9 satellite: the reliability layer's step-boundary fns
+    (deadline sweep, journal replay/recovery, drain loop) are held to
+    the hot-path bar — a per-request/per-step device sync fires."""
+    got = lint(src, path, rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"], (label, path)
+
+
+def test_host_sync_quiet_on_host_only_reliability_fns():
+    # the real implementations are pure host accounting: clock reads,
+    # dict walks, journal appends — no findings
+    assert lint(HS_RELIABILITY_GOOD, "deepspeed_tpu/serving/engine.py",
+                rules=["host-sync"]) == []
+    assert lint(HS_RELIABILITY_GOOD,
+                "deepspeed_tpu/serving/reliability.py",
+                rules=["host-sync"]) == []
+
+
 def test_host_sync_quiet_on_batched_fetch_after_loop():
     assert lint(HS_HOT_LOOP_GOOD, "deepspeed_tpu/runtime/engine.py",
                 rules=["host-sync"]) == []
@@ -614,6 +686,31 @@ def test_disarmed_discipline_covers_arm_stage3_path():
     assert rule_names(got) == ["disarmed-discipline"]
     assert "_arm_stage3" in got[0].message
     assert lint(DISARM_S3_GOOD, rules=["disarmed-discipline"]) == []
+
+
+DISARM_SHED_BAD = """
+class Reliability:
+    def _arm_shedding(self):
+        self.shedding_armed = self.config.slo_ttft_s is not None \\
+            and self.engine.scheduler.policy == "continuous"
+"""
+
+DISARM_SHED_GOOD = DISARM_SHED_BAD + """
+        if self.config.slo_ttft_s is not None and not self.shedding_armed:
+            logger.warning("SLO shedding DISARMED - policy '%s' gates "
+                           "admission on batch membership",
+                           self.engine.scheduler.policy)
+"""
+
+
+def test_disarmed_discipline_covers_arm_shedding_path():
+    """ISSUE 9 satellite: the serving overload guard's arming fn is
+    held to the armed-or-warns discipline — an _arm_shedding that can
+    silently leave the gate off fires; warning DISARMED quiets it."""
+    got = lint(DISARM_SHED_BAD, rules=["disarmed-discipline"])
+    assert rule_names(got) == ["disarmed-discipline"]
+    assert "_arm_shedding" in got[0].message
+    assert lint(DISARM_SHED_GOOD, rules=["disarmed-discipline"]) == []
 
 
 # ---------------------------------------------------------------------------

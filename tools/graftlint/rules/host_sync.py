@@ -43,13 +43,19 @@ HOT_FILES = {
     "deepspeed_tpu/serving/engine.py",
     "deepspeed_tpu/serving/scheduler.py",
     "deepspeed_tpu/serving/kv_cache.py",
+    "deepspeed_tpu/serving/reliability.py",
 }
 HOT_FN_RE = re.compile(
     r"^(train_batch|eval_batch|forward|backward|step"
     r"|_take_model_step\w*|_exec_\w+|_run_\w+"
     r"|serve\w*|submit|cancel|_decode_\w+|_prefill_\w+"
     r"|_on_new_token|_ensure_blocks|warmup"
-    r"|alloc|free|table_row)$")
+    r"|alloc|free|table_row"
+    # serving reliability layer (ISSUE 9): deadline sweeps, journal
+    # hooks and drain/recover all run at step boundaries — a device
+    # sync per live request there serializes the whole batch
+    r"|_enforce_deadlines|_abort|recover|drain|request_drain"
+    r"|on_\w+|record_\w+|commit|replay|predicted_\w+)$")
 # benchmark drivers: every loop is (or brackets) a timed region — a sync
 # per iteration pollutes the measured step time with transfer latency
 BENCH_FILES = {"bench.py", "tools/pipe_bench.py", "tools/serve_bench.py"}

@@ -1,30 +1,37 @@
 #!/usr/bin/env python
-"""Serving benchmark: continuous batching vs naive static batching.
+"""Serving benchmark: scheduling policies + adversarial traffic mixes.
 
-Drives the SAME InferenceEngine machinery under two scheduler policies
-over a mixed prompt/output-length workload with staggered arrivals:
+Traffic modes (``--traffic``):
 
-- ``continuous``: freed decode lanes are refilled on the next step
-  (token-level continuous batching, the serving subsystem's point);
-- ``static``: batch membership is fixed when the batch forms and every
-  batch drains to its slowest member — the classic batched-generate
-  serving loop.
+- ``steady`` (default) — the PR 5 A/B: the SAME engine under the
+  ``continuous`` vs ``static`` scheduler policies over a mixed
+  prompt/output-length workload with staggered arrivals.  Gate:
+  continuous >= 1.3x tokens per slot-step.
+- ``bursty`` — thundering-herd arrivals (bursts of `--burst` requests
+  every `--burst-gap` steps) on the continuous engine; reports how far
+  p95 TTFT degrades vs steady arrivals of the same workload.
+- ``overload`` — 2x-capacity arrivals with per-request deadlines, run
+  TWICE: SLO shedding ARMED vs DISARMED (the reliability layer's
+  graceful-degradation A/B).  Latencies run on a STEP clock (1.0/step)
+  so the comparison is deterministic; the guard mirrors tier-1
+  ``test_overload_shedding_guard``: armed p95 TTFT <= 2x SLO and armed
+  goodput >= 0.75x a steady-state baseline, while DISARMED shows the
+  congestion collapse (TTFT blow-up + wasted decoded tokens).
+- ``shared-prefix`` — every prompt shares a long system-prompt prefix
+  (ROADMAP item 3's workload; today it prices the duplicated prefill
+  that a future prefix cache removes).
 
-Because both modes share the engine (same jits, same per-step host
-work), the comparison isolates the SCHEDULING policy.  Two throughput
-views are reported:
+Two throughput views everywhere:
 
 - ``tokens_per_slot_step`` — generated tokens per dispatched decode
   lane: the deterministic hardware-time proxy (each decode step costs
-  one fixed-shape program execution regardless of how many lanes carry
-  live requests).  This is the number the >= 1.3x acceptance gate and
-  tests/unit/test_serving.py::test_continuous_beats_static_batching pin.
-- ``tokens_per_s`` — wall clock, for context.  On the CPU toy model a
-  decode step is microseconds of FLOPs under milliseconds of Python
-  dispatch, so wall clock mostly measures the host loop; on a real
-  accelerator the slot-step view is the one that translates.
+  one fixed-shape program execution regardless of live lanes).  The
+  overload mode further splits it into GOODPUT (finished requests'
+  tokens only) — the honest number once work can be shed/expired.
+- ``tokens_per_s`` — wall clock, for context (host-dispatch-bound on
+  the CPU toy model).
 
-  python tools/serve_bench.py [--json out.json] [--slots 8]
+  python tools/serve_bench.py [--traffic MODE] [--json out.json]
 """
 import argparse
 import json
@@ -34,6 +41,22 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+
+def _r(x, nd=4):
+    """round() that is total over the metrics report's None slots."""
+    return None if x is None else round(x, nd)
+
+
+class StepClock:
+    """Deterministic latency clock for the overload A/B: 1.0 per
+    serving step, advanced by the driver."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
 
 
 def build_toy(n_embd, n_layer, vocab):
@@ -70,77 +93,118 @@ def make_workload(n_requests, vocab, seed):
     return reqs
 
 
+def make_shared_prefix_workload(n_requests, vocab, seed, prefix_len=24):
+    """System-prompt traffic: one long shared prefix, short unique
+    tails.  Today every request re-prefills the prefix; the reported
+    duplicated-prefill tokens are the prefix cache's target."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(0, vocab, prefix_len).astype(np.int32)
+    reqs = []
+    for i in range(n_requests):
+        tail = rng.integers(0, vocab,
+                            int(rng.integers(4, 9))).astype(np.int32)
+        reqs.append((np.concatenate([prefix, tail]),
+                     int(rng.choice([4, 8]))))
+    return reqs
+
+
+def _arrival_schedule(n, *, every=1, burst=1, gap=0):
+    """Arrival step for request i: steady (``every``) or bursty
+    (``burst`` requests land together every ``gap`` steps)."""
+    if burst <= 1:
+        return [i * every for i in range(n)]
+    return [(i // burst) * gap for i in range(n)]
+
+
 def run_mode(model, params, workload, *, policy, slots, chunk,
-             arrival_every):
+             arrivals, reliability=None, clock=None, step_clock=False,
+             deadline=None):
     import jax
 
     from deepspeed_tpu.serving.engine import InferenceEngine
 
+    kw = {}
+    if reliability is not None:
+        kw["reliability"] = reliability
+    if clock is not None:
+        kw["clock"] = clock
     eng = InferenceEngine(model, params, max_slots=slots,
                           kv_block_size=16, prefill_chunk=chunk,
-                          max_blocks_per_seq=8, policy=policy)
+                          max_blocks_per_seq=8, policy=policy, **kw)
     eng.warmup()                       # compiles outside the timed region
     t0 = time.perf_counter()
-    pending = list(enumerate(workload))
+    pending = [(arrivals[i], w) for i, w in enumerate(workload)]
     submitted = 0
+    steps = 0
     while pending or eng.scheduler.has_work():
-        while pending and pending[0][0] * arrival_every <= eng.metrics.steps:
+        while pending and pending[0][0] <= steps:
             _, (prompt, max_new) = pending.pop(0)
-            eng.submit(prompt, max_new_tokens=max_new)
+            eng.submit(prompt, max_new_tokens=max_new,
+                       deadline_s=deadline)
             submitted += 1
         eng.step()
+        if step_clock:
+            clock.t += 1.0
+        steps += 1
     # one drain point for the whole run, NOT per step
     jax.block_until_ready(eng.pool.tensors.k)
     wall = time.perf_counter() - t0
     rep = eng.serving_report()
-    assert rep["requests"]["completed"] == submitted
+    rel = rep["reliability"]
     return {
         "policy": policy,
-        "wall_s": round(wall, 4),
+        "submitted": submitted,
+        "completed": rep["requests"]["completed"],
+        "aborted": rep["requests"]["aborted"],
+        "shed": rel["aborts"]["shed"],
+        "expired": rel["aborts"]["expired"],
+        "poisoned": rel["aborts"]["poisoned"],
+        "journal_depth": rel["journal_depth"],
+        "wall_s": _r(wall),
         "decode_steps": rep["steps"]["decode"],
         "tokens": rep["tokens"]["generated"],
-        "tokens_per_s": round(rep["tokens"]["generated"] / wall, 2),
+        "tokens_useful": rep["tokens"]["useful"],
+        "tokens_wasted": rep["tokens"]["wasted"],
+        "tokens_per_s": _r(rep["tokens"]["generated"] / wall, 2),
         "tokens_per_slot_step":
-            round(rep["throughput"]["tokens_per_slot_step"], 4),
-        "slot_utilization":
-            round(rep["throughput"]["slot_utilization"], 4),
-        "ttft_s_mean": round(rep["ttft_s"]["mean"], 4),
-        "ttft_s_p95": round(rep["ttft_s"]["p95"], 4),
-        "tpot_s_mean": round(rep["tpot_s"], 5) if rep["tpot_s"] else None,
-        "kv_occupancy_mean":
-            round(rep["kv_pool"]["occupancy_mean"], 4),
+            _r(rep["throughput"]["tokens_per_slot_step"]),
+        "goodput_tokens_per_slot_step":
+            _r(rep["throughput"]["goodput_tokens_per_slot_step"]),
+        "useful_fraction": _r(rep["throughput"]["useful_fraction"]),
+        "slot_utilization": _r(rep["throughput"]["slot_utilization"]),
+        "ttft_mean": _r(rep["ttft_s"]["mean"]),
+        "ttft_p95": _r(rep["ttft_s"]["p95"]),
+        "tpot_mean": _r(rep["tpot_s"], 5),
+        "predicted_ttft_mean":
+            _r(rel["admission"]["predicted_ttft_s"]["mean"]),
+        "kv_occupancy_mean": _r(rep["kv_pool"]["occupancy_mean"]),
     }
 
 
-def main(argv=None):
-    p = argparse.ArgumentParser()
-    p.add_argument("--slots", type=int, default=8)
-    p.add_argument("--requests", type=int, default=32)
-    p.add_argument("--chunk", type=int, default=16)
-    p.add_argument("--n-embd", type=int, default=64)
-    p.add_argument("--n-layer", type=int, default=2)
-    p.add_argument("--vocab", type=int, default=128)
-    p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--arrival-every", type=int, default=1,
-                   help="steps between request arrivals")
-    p.add_argument("--json", default=None)
-    args = p.parse_args(argv)
+def _print_row(name, r):
+    print(f"{name:>18}: {r['tokens']} tok ({r['tokens_useful']} useful) "
+          f"in {r['wall_s']}s | {r['tokens_per_slot_step']} tok/slot-step "
+          f"(goodput {r['goodput_tokens_per_slot_step']}) | "
+          f"TTFT mean {r['ttft_mean']} p95 {r['ttft_p95']} | "
+          f"shed {r['shed']} expired {r['expired']}")
 
-    model, params = build_toy(args.n_embd, args.n_layer, args.vocab)
+
+def run_steady(model, params, args, out):
+    """PR 5's continuous-vs-static policy A/B (>= 1.3x gate)."""
     workload = make_workload(args.requests, args.vocab, args.seed)
-    out = {"workload": {
+    arrivals = _arrival_schedule(len(workload), every=args.arrival_every)
+    out["workload"] = {
         "requests": args.requests, "slots": args.slots,
         "prompt_lens": [len(pr) for pr, _ in workload],
-        "max_new": [m for _, m in workload]}}
+        "max_new": [m for _, m in workload]}
     for policy in ("static", "continuous"):
         out[policy] = run_mode(model, params, workload, policy=policy,
                                slots=args.slots, chunk=args.chunk,
-                               arrival_every=args.arrival_every)
-        r = out[policy]
-        print(f"{policy:>11}: {r['tokens']} tok in {r['wall_s']}s "
-              f"({r['tokens_per_s']} tok/s wall, "
-              f"{r['tokens_per_slot_step']} tok/slot-step, "
-              f"TTFT {r['ttft_s_mean']}s mean / {r['ttft_s_p95']}s p95)")
+                               arrivals=arrivals)
+        _print_row(policy, out[policy])
+        assert out[policy]["completed"] == out[policy]["submitted"]
     ratio = out["continuous"]["tokens_per_slot_step"] \
         / out["static"]["tokens_per_slot_step"]
     wall_ratio = out["continuous"]["tokens_per_s"] \
@@ -149,11 +213,150 @@ def main(argv=None):
     out["speedup_tokens_per_s_wall"] = round(wall_ratio, 3)
     print(f"continuous / static: {ratio:.2f}x tokens per slot-step "
           f"({wall_ratio:.2f}x wall tokens/s)")
+    return 0 if ratio >= 1.3 else 1
+
+
+def run_bursty(model, params, args, out):
+    """Thundering-herd arrivals vs the same workload served steadily."""
+    workload = make_workload(args.requests, args.vocab, args.seed)
+    steady = run_mode(model, params, workload, policy="continuous",
+                      slots=args.slots, chunk=args.chunk,
+                      arrivals=_arrival_schedule(len(workload), every=2))
+    bursty = run_mode(
+        model, params, workload, policy="continuous", slots=args.slots,
+        chunk=args.chunk,
+        arrivals=_arrival_schedule(len(workload), burst=args.burst,
+                                   gap=args.burst_gap))
+    out["steady"], out["bursty"] = steady, bursty
+    _print_row("steady", steady)
+    _print_row("bursty", bursty)
+    out["burst_ttft_p95_ratio"] = _r(
+        bursty["ttft_p95"] / steady["ttft_p95"], 3) \
+        if steady["ttft_p95"] else None
+    print(f"bursty / steady p95 TTFT: {out['burst_ttft_p95_ratio']}x "
+          f"(bursts of {args.burst} every {args.burst_gap} steps)")
+    return 0
+
+
+def run_overload(model, params, args, out):
+    """2x-capacity traffic, shedding ARMED vs DISARMED (+ steady
+    baseline) on a step clock — the reliability layer's A/B."""
+    import numpy as np
+
+    rng = np.random.default_rng(args.seed)
+    n = args.requests
+    workload = [(rng.integers(0, args.vocab, 6).astype(np.int32), 8)
+                for _ in range(n)]
+    slo, deadline = args.slo_steps, args.deadline_steps
+    # capacity of this shape is admission-bound at ~1 request/step (ONE
+    # chunked prefill in flight); 2x = two arrivals per step
+    overload_arrivals = [i // args.overload_rate for i in range(n)]
+
+    def drive(tag, slo_ttft, arrivals, deadline_s):
+        clock = StepClock()
+        rel = {"slo_ttft_s": slo_ttft} if slo_ttft else None
+        return run_mode(model, params, workload, policy="continuous",
+                        slots=args.slots, chunk=args.chunk,
+                        arrivals=arrivals, reliability=rel, clock=clock,
+                        step_clock=True, deadline=deadline_s)
+
+    steady = drive("steady", None,
+                   _arrival_schedule(n, every=3), None)
+    armed = drive("armed", slo, overload_arrivals, deadline)
+    disarmed = drive("disarmed", None, overload_arrivals, deadline)
+    out.update({"steady": steady, "armed": armed, "disarmed": disarmed,
+                "slo_steps": slo, "deadline_steps": deadline,
+                "latency_unit": "serving steps (step clock)"})
+    _print_row("steady (1x)", steady)
+    _print_row("armed (2x)", armed)
+    _print_row("DISARMED (2x)", disarmed)
+
+    ok = True
+    if not (armed["shed"] > 0):
+        print("GUARD FAIL: overload never tripped the admission gate")
+        ok = False
+    if not (armed["ttft_p95"] <= 2 * slo):
+        print(f"GUARD FAIL: armed p95 TTFT {armed['ttft_p95']} "
+              f"> 2x SLO {2 * slo}")
+        ok = False
+    floor = 0.75 * steady["goodput_tokens_per_slot_step"]
+    if not (armed["goodput_tokens_per_slot_step"] >= floor):
+        print(f"GUARD FAIL: armed goodput "
+              f"{armed['goodput_tokens_per_slot_step']} < floor {floor}")
+        ok = False
+    collapse = (disarmed["ttft_p95"] >= 1.5 * armed["ttft_p95"]
+                and disarmed["expired"] > 0
+                and disarmed["tokens_wasted"] > 0)
+    if not collapse:
+        print("GUARD FAIL: DISARMED baseline did not degrade — the "
+              "armed win is not demonstrated")
+        ok = False
+    out["guard_ok"] = ok
+    print(f"overload guard: {'OK' if ok else 'FAIL'} — armed p95 "
+          f"{armed['ttft_p95']} steps vs DISARMED {disarmed['ttft_p95']}; "
+          f"goodput {armed['goodput_tokens_per_slot_step']} vs "
+          f"{disarmed['goodput_tokens_per_slot_step']} "
+          f"(steady {steady['goodput_tokens_per_slot_step']})")
+    return 0 if ok else 1
+
+
+def run_shared_prefix(model, params, args, out):
+    workload = make_shared_prefix_workload(args.requests, args.vocab,
+                                           args.seed)
+    r = run_mode(model, params, workload, policy="continuous",
+                 slots=args.slots, chunk=args.chunk,
+                 arrivals=_arrival_schedule(len(workload), every=1))
+    out["shared_prefix"] = r
+    prefix_tokens = 24 * (args.requests - 1)
+    out["duplicated_prefill_tokens"] = prefix_tokens
+    _print_row("shared-prefix", r)
+    print(f"duplicated prefix prefill: {prefix_tokens} tokens "
+          f"(24-token system prompt x {args.requests - 1} re-prefills — "
+          f"the prefix-cache target, ROADMAP item 3)")
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--traffic", default="steady",
+                   choices=["steady", "bursty", "overload",
+                            "shared-prefix"])
+    p.add_argument("--slots", type=int, default=8)
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--chunk", type=int, default=16)
+    p.add_argument("--n-embd", type=int, default=64)
+    p.add_argument("--n-layer", type=int, default=2)
+    p.add_argument("--vocab", type=int, default=128)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--arrival-every", type=int, default=1,
+                   help="steps between request arrivals (steady)")
+    p.add_argument("--burst", type=int, default=8,
+                   help="requests per burst (bursty)")
+    p.add_argument("--burst-gap", type=int, default=24,
+                   help="steps between bursts (bursty)")
+    p.add_argument("--overload-rate", type=int, default=2,
+                   help="arrivals per step at overload (2 = 2x the "
+                        "admission-bound capacity)")
+    p.add_argument("--slo-steps", type=float, default=8.0,
+                   help="TTFT SLO in steps (overload)")
+    p.add_argument("--deadline-steps", type=float, default=24.0,
+                   help="per-request deadline in steps (overload)")
+    p.add_argument("--json", default=None)
+    args = p.parse_args(argv)
+
+    model, params = build_toy(args.n_embd, args.n_layer, args.vocab)
+    out = {"traffic": args.traffic,
+           "config": {"slots": args.slots, "requests": args.requests,
+                      "chunk": args.chunk, "seed": args.seed}}
+    rc = {"steady": run_steady, "bursty": run_bursty,
+          "overload": run_overload,
+          "shared-prefix": run_shared_prefix}[args.traffic](
+        model, params, args, out)
     if args.json:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=2)
         print(f"wrote {args.json}")
-    return 0 if ratio >= 1.3 else 1
+    return rc
 
 
 if __name__ == "__main__":

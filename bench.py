@@ -110,6 +110,30 @@ def _phase(name):
     print(f"PHASE:{name}", file=sys.stderr, flush=True)
 
 
+def _telemetry_paths(args):
+    """Per-attempt telemetry artifact paths under --telemetry-dir (None
+    when disabled with an empty dir).  Named by config + wall time so
+    retried rungs never clobber a dead round's evidence."""
+    tdir = getattr(args, "telemetry_dir", None)
+    if not tdir:
+        return None
+    try:
+        os.makedirs(tdir, exist_ok=True)
+    except OSError as e:
+        print(f"[bench] telemetry dir {tdir!r} unusable ({e}); telemetry "
+              f"artifact disabled for this attempt", file=sys.stderr,
+              flush=True)
+        return None
+    # pid + nanosecond stamp: same-config retries (even sub-second ones,
+    # even across worker processes) never share an artifact path, so a
+    # retry can't append into a dead attempt's JSONL or overwrite its
+    # trace
+    stamp = (f"{args.model}_b{args.batch}_s{args.seq}"
+             f"_{os.getpid()}_{time.time_ns()}")
+    return {"metrics": os.path.join(tdir, f"metrics_{stamp}.jsonl"),
+            "trace": os.path.join(tdir, f"trace_{stamp}.json")}
+
+
 def _worker_setup(args):
     """Import jax + probe the backend ONCE; returns the context every
     attempt shares.  This is the expensive, flake-prone part the serve
@@ -244,6 +268,14 @@ def _run_one(args, ctx) -> int:
         "mesh": {"data": n_dev, "model": 1, "pipe": 1},
         "steps_per_print": 10 ** 9,
     }
+    # per-round telemetry artifact (ISSUE 10): a step-aligned metrics
+    # JSONL + an exported Chrome trace, so a round that dies mid-ladder
+    # still leaves step evidence beyond the phase cache.  The JSONL is
+    # torn-tail tolerant by construction (MetricsStream.replay).
+    tele_paths = _telemetry_paths(args)
+    if tele_paths:
+        ds_config["telemetry"] = {"enabled": True,
+                                  "metrics_jsonl": tele_paths["metrics"]}
     engine, _, _, _ = deepspeed_tpu.initialize(model=model,
                                                config_params=ds_config)
     phase("engine_up")
@@ -303,11 +335,30 @@ def _run_one(args, ctx) -> int:
     peak, peak_known = _peak_tflops(device_kind)
     vs_baseline = tflops_per_chip / REFERENCE_TFLOPS_PER_CHIP
 
+    telemetry_out = None
+    if tele_paths:
+        trace_path = None
+        mfu_rep = None
+        try:
+            trace_path = engine.export_trace(tele_paths["trace"])
+            rep = engine.telemetry_report()
+            mfu_rep = {k: rep["mfu"].get(k) for k in
+                       ("hw_flops_per_step", "model_flops_per_step",
+                        "mfu", "hfu", "step_time_s")} \
+                if "mfu" in rep else None
+        except Exception as e:  # lint: allow-broad-except — telemetry
+            # must never cost the round its perf number
+            print(f"[bench] telemetry_report failed: {e}",
+                  file=sys.stderr, flush=True)
+        telemetry_out = {"metrics_jsonl": tele_paths["metrics"],
+                         "trace": trace_path, "mfu": mfu_rep}
+
     print(json.dumps({
         "metric": f"{args.model}{'-sparse' if args.sparse else ''} "
                   f"seq{args.seq} train TFLOPS/chip "
                   f"(ZeRO-2{'+offload' if args.offload else ''} bf16, "
                   f"{n_dev} chip)",
+        "telemetry": telemetry_out,
         "value": round(tflops_per_chip, 2),
         "unit": "TFLOPS/chip",
         "vs_baseline": round(vs_baseline, 3),
@@ -611,6 +662,10 @@ class _ServeWorker:
         out_i, err_i, ph_i = (len(self.stdout_lines),
                               len(self.stderr_lines), len(self.phases))
         payload = {k: getattr(base, k) for k in _SPEC_KEYS}
+        # passthrough knobs that must reach the worker but are NOT part
+        # of the phase-cache config identity (telemetry never changes
+        # what is being measured, only what evidence the round leaves)
+        payload["telemetry_dir"] = getattr(base, "telemetry_dir", None)
         payload.update(spec)
         t0 = time.time()
         try:
@@ -804,6 +859,19 @@ def run_parent(args) -> int:
                         _record(ckey, ok=True, last_phase=last_phase,
                                 elapsed_s=elapsed,
                                 value=payload.get("value"))
+                        # perf trajectory (ISSUE 10): trend this payload
+                        # against prior BENCH_*.json rounds so every
+                        # round reports where it stands; a regression is
+                        # flagged here and FAILED by tools/perf_trend.py
+                        # --check in the bench flow
+                        try:
+                            from tools import perf_trend
+
+                            payload["perf_trend"] = perf_trend.trend_payload(
+                                latest=payload)
+                        except Exception as e:  # lint: allow-broad-except
+                            # trend reporting must never eat the number
+                            payload["perf_trend"] = {"error": str(e)}
                         print(json.dumps(payload), flush=True)
                         return 0
                     except ValueError:
@@ -870,6 +938,13 @@ def main():
                         "and the measured import cost ACROSS rounds; a "
                         "fresh round runs the last-good config first and "
                         "skips rungs that previously died past backend-up")
+    p.add_argument("--telemetry-dir", dest="telemetry_dir",
+                   default=os.environ.get("BENCH_TELEMETRY_DIR",
+                                          "bench_telemetry"),
+                   help="directory for per-round telemetry artifacts "
+                        "(step-metrics JSONL + Chrome trace; paths land "
+                        "in the output JSON under 'telemetry'); empty "
+                        "string disables")
     p.add_argument("--model", default="gpt2-350m")
     p.add_argument("--scan_layers", type=int, default=1)
     p.add_argument("--remat", type=int, default=1)

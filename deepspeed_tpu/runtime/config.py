@@ -359,6 +359,41 @@ def get_pipeline_config(param_dict):
     }
 
 
+def get_telemetry_config(param_dict):
+    """"telemetry" ds_config section: tracing + metrics stream + MFU.
+
+    Everything defaults OFF (the master ``enabled`` switch) — telemetry
+    is opt-in observability, and disarmed must cost exactly nothing on
+    the step path."""
+    d = param_dict.get(TELEMETRY, {})
+    capacity = int(d.get(TELEMETRY_TRACE_CAPACITY,
+                         TELEMETRY_TRACE_CAPACITY_DEFAULT))
+    if capacity < 256:
+        raise ValueError(
+            f"telemetry.{TELEMETRY_TRACE_CAPACITY} must be >= 256 events "
+            f"(got {capacity}); a smaller ring drops spans mid-step and "
+            f"the trace replay refuses to run on a holey stream")
+    peak = float(d.get(TELEMETRY_PEAK_TFLOPS,
+                       TELEMETRY_PEAK_TFLOPS_DEFAULT))
+    if peak < 0:
+        raise ValueError(
+            f"telemetry.{TELEMETRY_PEAK_TFLOPS} must be >= 0 TFLOPS "
+            f"(0 = auto-detect from the device kind), got {peak}")
+    return {
+        TELEMETRY_ENABLED: bool(d.get(TELEMETRY_ENABLED,
+                                      TELEMETRY_ENABLED_DEFAULT)),
+        TELEMETRY_TRACE: bool(d.get(TELEMETRY_TRACE,
+                                    TELEMETRY_TRACE_DEFAULT)),
+        TELEMETRY_TRACE_CAPACITY: capacity,
+        TELEMETRY_METRICS_JSONL: d.get(TELEMETRY_METRICS_JSONL,
+                                       TELEMETRY_METRICS_JSONL_DEFAULT),
+        TELEMETRY_METRICS_FSYNC: bool(d.get(TELEMETRY_METRICS_FSYNC,
+                                            TELEMETRY_METRICS_FSYNC_DEFAULT)),
+        TELEMETRY_MFU: bool(d.get(TELEMETRY_MFU, TELEMETRY_MFU_DEFAULT)),
+        TELEMETRY_PEAK_TFLOPS: peak,
+    }
+
+
 class DeepSpeedConfig:
     def __init__(self, json_file_or_dict, mpu=None, param_dict=None, world_size=None):
         if param_dict is None:
@@ -468,6 +503,7 @@ class DeepSpeedConfig:
         self.mesh_shape = get_mesh_shape(param_dict)
         self.pipeline = get_pipeline_config(param_dict)
         self.resilience = get_resilience_config(param_dict)
+        self.telemetry = get_telemetry_config(param_dict)
 
     def _batch_assertion(self):
         train_batch = self.train_batch_size

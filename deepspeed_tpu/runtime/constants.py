@@ -209,6 +209,28 @@ WATCHDOG_ACTION_DEFAULT = "abort"
 WATCHDOG_EMERGENCY_DIR = "emergency_checkpoint_dir"  # None = last save_dir
 WATCHDOG_EMERGENCY_DIR_DEFAULT = None
 
+#############################################
+# Telemetry (TPU extension): structured step tracing, unified metrics
+# stream, measured-vs-analytic MFU accounting (deepspeed_tpu/telemetry/)
+#############################################
+TELEMETRY = "telemetry"
+TELEMETRY_ENABLED = "enabled"                   # master switch
+TELEMETRY_ENABLED_DEFAULT = False
+TELEMETRY_TRACE = "trace"                       # span tracer channel
+TELEMETRY_TRACE_DEFAULT = True
+TELEMETRY_TRACE_CAPACITY = "trace_capacity"     # ring-buffer events
+TELEMETRY_TRACE_CAPACITY_DEFAULT = 65536
+TELEMETRY_METRICS_JSONL = "metrics_jsonl"       # step stream path; None = off
+TELEMETRY_METRICS_JSONL_DEFAULT = None
+TELEMETRY_METRICS_FSYNC = "metrics_fsync"       # fsync each step record
+TELEMETRY_METRICS_FSYNC_DEFAULT = False
+TELEMETRY_MFU = "mfu"                           # cost_analysis MFU channel
+TELEMETRY_MFU_DEFAULT = True
+# explicit bf16 peak TFLOPS per device for MFU/HFU ratios; 0 = auto from
+# the device kind (unknown kinds — CPU meshes — report mfu=None)
+TELEMETRY_PEAK_TFLOPS = "peak_tflops_per_device"
+TELEMETRY_PEAK_TFLOPS_DEFAULT = 0.0
+
 PIPELINE = "pipeline"               # pipeline engine knobs
 PIPELINE_STAGES = "stages"
 PIPELINE_STAGES_DEFAULT = 1

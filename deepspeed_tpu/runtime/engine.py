@@ -23,6 +23,7 @@ import logging
 import os
 import pickle
 import time
+import weakref
 from typing import Any, NamedTuple, Optional
 
 import numpy as np
@@ -176,6 +177,9 @@ class DeepSpeedEngine:
                 max_nan_losses=res.watchdog_max_nan_losses,
                 stall_timeout=res.watchdog_stall_timeout,
                 default_action=res.watchdog_action)
+
+        # --- telemetry (ISSUE 10) -----------------------------------------
+        self._arm_telemetry()
 
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
@@ -896,6 +900,210 @@ class DeepSpeedEngine:
                 f"ZeRO qgZ: hierarchical_allreduce has no effect — it "
                 f"routes the quantized gradient exchange and {why}",
                 ranks=[0], level=logging.WARNING)
+
+    # ------------------------------------------------------------------
+    # telemetry (deepspeed_tpu/telemetry/, ISSUE 10)
+    # ------------------------------------------------------------------
+    def _arm_telemetry(self):
+        """Build the telemetry session (span tracer + metrics registry/
+        stream + MFU accounting) when the ``telemetry`` config block asks
+        for it.  Disarmed engines hold ``self._tracer = None`` — every
+        instrumentation site is one attribute check, tracing is purely
+        host-side, and the compiled programs are UNTOUCHED either way
+        (bit-identical steps, zero extra compiles; pinned by tier-1
+        tests).  Sub-knobs set while the master switch is off would
+        silently observe nothing, so that DISARMED state warns loudly
+        (the OneBitAdam/qgZ discipline)."""
+        from deepspeed_tpu.runtime.constants import (
+            TELEMETRY_ENABLED, TELEMETRY_METRICS_FSYNC,
+            TELEMETRY_METRICS_JSONL, TELEMETRY_MFU, TELEMETRY_PEAK_TFLOPS,
+            TELEMETRY_TRACE, TELEMETRY_TRACE_CAPACITY)
+
+        tc = self._config.telemetry
+        self._telemetry = None
+        self._tracer = None
+        self._chaos_observer = None
+        self._lane_train = 0
+        self._lane_ckpt = 0
+        self._mfu_n_params = None
+        self._mfu_tokens_per_step = None
+        if not tc[TELEMETRY_ENABLED]:
+            if tc[TELEMETRY_METRICS_JSONL]:
+                log_dist(
+                    "telemetry: DISARMED — telemetry.metrics_jsonl is set "
+                    "but telemetry.enabled=false, so no trace, step stream "
+                    "or MFU accounting will be produced; set "
+                    "telemetry.enabled=true to arm it",
+                    ranks=[0], level=logging.WARNING)
+            return
+        from deepspeed_tpu.telemetry import Telemetry
+
+        self._telemetry = Telemetry(
+            trace=tc[TELEMETRY_TRACE],
+            trace_capacity=tc[TELEMETRY_TRACE_CAPACITY],
+            metrics_jsonl=tc[TELEMETRY_METRICS_JSONL],
+            metrics_fsync=tc[TELEMETRY_METRICS_FSYNC],
+            mfu=tc[TELEMETRY_MFU],
+            peak_tflops_per_device=tc[TELEMETRY_PEAK_TFLOPS])
+        tr = self._telemetry.tracer
+        self._tracer = tr
+        if tr is not None:
+            self._lane_train = tr.lane("train")
+            self._lane_ckpt = tr.lane("ckpt")
+            tr.intern("optimizer_step", args=("global_step",))
+            tr.intern("overflow_skip", args=("global_step",))
+            tr.intern("preempt", args=("global_step",))
+            if self._watchdog is not None:
+                # observe-only callback: returns None so the verdict
+                # stays with the configured callbacks/default action
+                self._watchdog.add_callback(self._telemetry_watchdog_cb)
+            from deepspeed_tpu.runtime.resilience import chaos
+
+            # the chaos observer list is PROCESS-GLOBAL: register a
+            # weakref trampoline, not a bound method, so an abandoned
+            # engine (bench ladders build one per attempt) stays
+            # collectable and its __del__ can deregister cleanly
+            ref = weakref.ref(self)
+
+            def _chaos_obs(kind, detail=None):
+                eng = ref()
+                if eng is not None:
+                    eng._telemetry_chaos_cb(kind, detail)
+
+            self._chaos_observer = chaos.add_observer(_chaos_obs)
+        log_dist(
+            f"telemetry armed: trace={tc[TELEMETRY_TRACE]} "
+            f"(capacity {tc[TELEMETRY_TRACE_CAPACITY]}), "
+            f"metrics_jsonl={tc[TELEMETRY_METRICS_JSONL] or 'off'}, "
+            f"mfu={tc[TELEMETRY_MFU]}", ranks=[0])
+
+    def _telemetry_watchdog_cb(self, event):
+        tr = self._tracer
+        if tr is not None:
+            tr.instant(f"watchdog_{event.kind}", self._lane_train,
+                       a0=int(event.step))
+        return None
+
+    def _telemetry_chaos_cb(self, kind, detail=None):
+        tr = self._tracer
+        if tr is not None:
+            tr.instant(f"chaos_{kind}", self._lane_train)
+
+    def close_telemetry(self):
+        """Release the telemetry session's process-global hooks (the
+        chaos observer) and close the metrics-stream file handle.
+        Idempotent; also runs at GC so loops that build many engines
+        (bench ladders) never accumulate observers or leak JSONL fds.
+        The session object stays readable — only the stream is closed."""
+        obs = getattr(self, "_chaos_observer", None)
+        if obs is not None:
+            self._chaos_observer = None
+            from deepspeed_tpu.runtime.resilience import chaos
+
+            chaos.remove_observer(obs)
+        tel = getattr(self, "_telemetry", None)
+        if tel is not None:
+            tel.close()
+
+    def __del__(self):
+        try:
+            self.close_telemetry()
+        except Exception:  # lint: allow-broad-except — interpreter
+            # teardown can fail imports mid-GC; never raise from __del__
+            pass
+
+    @property
+    def telemetry(self):
+        """The armed Telemetry session, or None."""
+        return self._telemetry
+
+    def export_trace(self, path, complete_events=True):
+        """Write the retained trace as Chrome-trace-event JSON (loadable
+        in chrome://tracing / Perfetto); None when tracing is disarmed."""
+        tr = self._tracer
+        if tr is None:
+            return None
+        return tr.export_chrome_trace(path, complete_events=complete_events)
+
+    def _register_mfu_jit(self, name, jit_fn, args, calls_per_step=1.0):
+        """Capture-by-shape registration of a dispatched jit with the MFU
+        ledger: a ShapeDtypeStruct tree of the REAL dispatch args is taken
+        once (first dispatch; donated buffers still alive) and the
+        lower+compile+cost_analysis runs lazily at report time — never on
+        the step path, never inside a recompile-guard window."""
+        tel = self._telemetry
+        if tel is None:
+            return
+        from deepspeed_tpu.telemetry import register_by_shape
+
+        register_by_shape(tel.mfu, name, jit_fn, args, mesh=self.mesh,
+                          calls_per_step=calls_per_step)
+
+    def _note_mfu_workload(self, batch, micros_in_batch=1):
+        """Record the 6ND inputs once: parameter count (from the live
+        state) and tokens per optimizer step (largest integer leaf of the
+        dispatched batch × the accumulation factor not already in its
+        shape)."""
+        if self._telemetry is None or self._mfu_tokens_per_step is not None:
+            return
+        import jax
+
+        if self.state is not None:
+            self._mfu_n_params = sum(
+                int(l.size)
+                for l in jax.tree_util.tree_leaves(self.state.params))
+        tokens = 0
+        for leaf in jax.tree_util.tree_leaves(batch):
+            dt = getattr(leaf, "dtype", None)
+            if dt is not None and np.issubdtype(np.dtype(dt), np.integer):
+                tokens = max(tokens, int(np.prod(np.shape(leaf))))
+        if tokens:
+            self._mfu_tokens_per_step = tokens * max(1, micros_in_batch)
+
+    def _mfu_report(self):
+        tel = self._telemetry
+        from deepspeed_tpu.telemetry import model_flops_per_step
+
+        devs = self.mesh.devices.reshape(-1)
+        model_flops = None
+        if self._mfu_n_params and self._mfu_tokens_per_step:
+            model_flops = model_flops_per_step(self._mfu_n_params,
+                                               self._mfu_tokens_per_step)
+        rep = tel.mfu.report(
+            step_time_s=tel.step_time_s(), n_devices=int(len(devs)),
+            model_flops=model_flops,
+            device_kind=getattr(devs[0], "device_kind", None))
+        rep["n_params"] = self._mfu_n_params
+        rep["tokens_per_step"] = self._mfu_tokens_per_step
+        return rep
+
+    def telemetry_report(self):
+        """ONE observability report: consolidates the legacy builders —
+        ``_last_metrics`` (per-step scalars), ``comm_volume_report()``
+        (analytic wire bytes), and on subclasses ``pipeline_report()`` /
+        ``serving_report()`` — behind a single dict WITHOUT replacing
+        them, plus the telemetry-only sections: the metrics-registry
+        snapshot, the trace summary, and the measured-vs-analytic
+        MFU/HFU ledger (``mfu``, populated from
+        ``compiled.cost_analysis()``)."""
+        report = {
+            "engine": type(self).__name__,
+            "global_steps": self.global_steps,
+            "telemetry_armed": self._telemetry is not None,
+            "last_metrics": dict(self._last_metrics)
+            if isinstance(self._last_metrics, dict) else self._last_metrics,
+        }
+        if self.state is not None:
+            report["comm"] = self.comm_volume_report()
+        tel = self._telemetry
+        if tel is None:
+            return report
+        report["metrics"] = tel.registry.snapshot()
+        if tel.tracer is not None:
+            report["trace"] = tel.tracer.summary()
+        if tel.mfu is not None:
+            report["mfu"] = self._mfu_report()
+        return report
 
     def _use_loss_scaler(self):
         return self.fp16_enabled()
@@ -2221,17 +2429,27 @@ class DeepSpeedEngine:
         self._maybe_profile(dev_batch)
         import jax
 
+        gas = self.gradient_accumulation_steps()
+        self._note_mfu_workload(dev_batch, micros_in_batch=gas)
+        tr = self._tracer
+        _t0 = tr.begin() if tr is not None else 0.0
         with jax.set_mesh(self.mesh):
             if getattr(self, "_jit_s3_fwd", None) is not None:
                 # scheduled stage-3: the forward does NOT donate the state
                 # — it stays alive; what stages is the vjp stash, whose
                 # residuals hold the once-gathered weights for backward
+                self._register_mfu_jit("s3_fwd", self._jit_s3_fwd,
+                                       (self.state, dev_batch), gas)
                 loss, self._pending_s3_stash = \
                     self._jit_s3_fwd(self.state, dev_batch)
                 self._pending_loss = loss
+                if tr is not None:
+                    tr.complete("forward_micro", self._lane_train, _t0)
                 if self.wall_clock_breakdown():
                     self.timers(FORWARD_MICRO_TIMER).stop()
                 return loss
+            self._register_mfu_jit("micro_step", self._jit_micro,
+                                   (self.state, dev_batch), gas)
             if self._offload:
                 new_state, loss, grads = self._jit_micro(self.state,
                                                          dev_batch)
@@ -2242,6 +2460,8 @@ class DeepSpeedEngine:
         # staged state (the donated input buffers now live inside it).
         self._pending_state = new_state
         self._pending_loss = loss
+        if tr is not None:
+            tr.complete("forward_micro", self._lane_train, _t0)
         if self.wall_clock_breakdown():
             self.timers(FORWARD_MICRO_TIMER).stop()
         return loss
@@ -2257,6 +2477,8 @@ class DeepSpeedEngine:
         """
         if self.wall_clock_breakdown():
             self.timers(BACKWARD_MICRO_TIMER).start()
+        tr = self._tracer
+        _t0 = tr.begin() if tr is not None else 0.0
         if self._pending_s3_stash is not None:
             # scheduled stage-3: evaluate the stash (gradients land
             # sharded through the gather's cotangent constraint) and
@@ -2268,6 +2490,8 @@ class DeepSpeedEngine:
                                               self._pending_s3_stash)
             self._pending_s3_stash = None
             self.micro_steps += 1
+            if tr is not None:
+                tr.complete("backward_micro", self._lane_train, _t0)
             if self.wall_clock_breakdown():
                 self.timers(BACKWARD_MICRO_TIMER).stop()
             return loss
@@ -2281,11 +2505,18 @@ class DeepSpeedEngine:
             # compute). Keeping at most one fetch in flight bounds device
             # memory to one grad tree — gas in-flight trees would cost more
             # HBM than the accumulator this path removed.
+            _tg = tr.begin() if tr is not None else 0.0
             fetch = self._start_grad_fetch(self._pending_grads)
             self._pending_grads = None
             self._drain_pending_fetches()
             self._pending_fetches.append(fetch)
+            if tr is not None:
+                # the host-visible half of the offload gradient exchange
+                # (device→host shard stream; the collective half is in-jit)
+                tr.complete("grad_exchange_d2h", self._lane_train, _tg)
         self.micro_steps += 1
+        if tr is not None:
+            tr.complete("backward_micro", self._lane_train, _t0)
         if self.wall_clock_breakdown():
             self.timers(BACKWARD_MICRO_TIMER).stop()
         return loss
@@ -2314,6 +2545,8 @@ class DeepSpeedEngine:
         ICI instead of a full H2D upload per process."""
         import jax
 
+        tr = self._tracer
+        _t0 = tr.begin() if tr is not None else 0.0
         lr = self._advance_lr()
         state = self.state
         self._drain_pending_fetches()
@@ -2391,6 +2624,12 @@ class DeepSpeedEngine:
         self.global_steps += 1
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
+        if tr is not None:
+            tr.complete("optimizer_step", self._lane_train, _t0,
+                        a0=self.global_steps)
+            if not finite:
+                tr.instant("overflow_skip", self._lane_train,
+                           a0=self.global_steps)
         self._last_metrics = self._annotate_comm(
             {"overflow": not finite,
              "grad_norm": getattr(self, "_last_grad_norm", 0.0),
@@ -2407,11 +2646,18 @@ class DeepSpeedEngine:
         import jax
         import jax.numpy as jnp
 
+        tr = self._tracer
+        _t0 = tr.begin() if tr is not None else 0.0
         with jax.set_mesh(self.mesh):
-            new_state, metrics = self._apply_callable()(
-                self.state, jnp.float32(lr))
+            apply_fn = self._apply_callable()
+            self._register_mfu_jit("apply_step", apply_fn,
+                                   (self.state, jnp.float32(lr)))
+            new_state, metrics = apply_fn(self.state, jnp.float32(lr))
         self.state = new_state
         self.global_steps += 1
+        if tr is not None:
+            tr.complete("optimizer_step", self._lane_train, _t0,
+                        a0=self.global_steps)
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
         self._last_metrics = metrics = self._annotate_comm(metrics)
@@ -2423,6 +2669,10 @@ class DeepSpeedEngine:
             # fetch on the already-host-driven non-fused path
             overflow = bool(jax.device_get(metrics["overflow"]))
             if overflow:
+                if tr is not None:
+                    # loss-scale event: the scaler halves on this skip
+                    tr.instant("overflow_skip", self._lane_train,
+                               a0=self.global_steps)
                 log_dist(
                     f"OVERFLOW! Skipping step {self.global_steps}; "
                     f"reducing loss scale to "
@@ -2468,11 +2718,16 @@ class DeepSpeedEngine:
             # stage2.py:876-958)
             self._maybe_profile(self._shard_batch(_first_micro(batch)))
             self.tput_timer.start()
+            tr = self._tracer
+            _t0 = tr.begin() if tr is not None else 0.0
             losses = []
             prev_fetch = None
             with jax.set_mesh(self.mesh):
                 for i in range(gas):
                     dev_micro = self._shard_batch(_micro_at(batch, i))
+                    self._note_mfu_workload(dev_micro, micros_in_batch=gas)
+                    self._register_mfu_jit("micro_offload", self._jit_micro,
+                                           (self.state, dev_micro), gas)
                     self.state, loss, grads = self._jit_micro(self.state,
                                                               dev_micro)
                     fetch = self._start_grad_fetch(grads)
@@ -2484,6 +2739,9 @@ class DeepSpeedEngine:
                 self._consume_grad_fetch(prev_fetch)
             self.micro_steps += gas
             self._pending_loss = jnp.mean(jnp.stack(losses))
+            if tr is not None:
+                tr.complete("train_batch_micros", self._lane_train, _t0,
+                            a0=gas)
             self._chaos_poison_accum()
             self._take_model_step_offload()  # reports progress itself
             self.tput_timer.stop()
@@ -2495,11 +2753,21 @@ class DeepSpeedEngine:
 
         self._chaos_poison_accum()
         self.tput_timer.start()
+        self._note_mfu_workload(dev)
+        tr = self._tracer
+        _t0 = tr.begin() if tr is not None else 0.0
         with jax.set_mesh(self.mesh):
-            new_state, metrics = self._fused_callable()(
-                self.state, dev, jnp.float32(lr))
+            fused_fn = self._fused_callable()
+            self._register_mfu_jit("fused_train_step", fused_fn,
+                                   (self.state, dev, jnp.float32(lr)))
+            new_state, metrics = fused_fn(self.state, dev, jnp.float32(lr))
         self.state = new_state
         self.global_steps += 1
+        if tr is not None:
+            # the fused jit carries micro fwd/bwd, the grad exchange AND
+            # the optimizer step in one dispatch — one span per step
+            tr.complete("fused_train_step", self._lane_train, _t0,
+                        a0=self.global_steps)
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
         self.micro_steps += gas
@@ -2599,6 +2867,14 @@ class DeepSpeedEngine:
             metrics["ckpt_commit_pending"] = \
                 int(self._pending_commit is not None)
             self._last_metrics = metrics
+        if self._telemetry is not None:
+            # step-aligned telemetry boundary: step_time histogram + one
+            # JSONL record of this step's metrics (journal idiom — flush
+            # per emit, a crash tears at most the final line)
+            self._telemetry.on_step(
+                self.global_steps,
+                self._last_metrics
+                if isinstance(self._last_metrics, dict) else None)
         if self._watchdog is not None:
             from deepspeed_tpu.runtime.resilience.watchdog import \
                 WatchdogAlarm
@@ -2663,6 +2939,9 @@ class DeepSpeedEngine:
         if not want:
             return
         self._preempt_requested = True  # latch (peer-initiated preempts)
+        if self._tracer is not None:
+            self._tracer.instant("preempt", self._lane_train,
+                                 a0=self.global_steps)
         tag, save_dir = self._preempt_checkpoint()
         from deepspeed_tpu.runtime.resilience.watchdog import \
             GracefulPreemption
@@ -2709,6 +2988,10 @@ class DeepSpeedEngine:
         import jax
 
         from deepspeed_tpu.runtime.resilience.watchdog import EVENT_STALL
+
+        if self._tracer is not None:
+            self._tracer.instant("emergency_checkpoint", self._lane_ckpt,
+                                 a0=self.global_steps)
 
         if event is not None and event.kind == EVENT_STALL \
                 and jax.process_count() > 1:
@@ -3041,8 +3324,7 @@ class DeepSpeedEngine:
 
             backend_r, write_fn = self._ckpt_snapshot_writer(client_state,
                                                              backend_r)
-            hb = self._watchdog.heartbeat if self._watchdog is not None \
-                else None
+            hb = self._ckpt_commit_heartbeat()
             if jax.process_count() > 1 and jax.process_index() != 0:
                 # npz-family backends write payload on process 0 only;
                 # peers hold a placeholder so every rank runs the same
@@ -3059,6 +3341,9 @@ class DeepSpeedEngine:
                                          "backend": backend_r}
             self._ckpt_foreground_ms = (_time.perf_counter() - t0) * 1000.0
             self._publish_ckpt_metrics()
+            if self._tracer is not None:
+                self._tracer.complete("ckpt_async_submit", self._lane_ckpt,
+                                      t0, a0=self.global_steps)
             log_dist(f"Async checkpoint commit in flight for tag {tag!r} "
                      f"(snapshot took "
                      f"{self._ckpt_foreground_ms:.1f} ms foreground; "
@@ -3171,7 +3456,31 @@ class DeepSpeedEngine:
         # number for the async path's rename-only foreground
         self._ckpt_foreground_ms = (_time.perf_counter() - t0) * 1000.0
         self._publish_ckpt_metrics()
+        if self._tracer is not None:
+            self._tracer.complete("ckpt_sync_commit", self._lane_ckpt, t0,
+                                  a0=self.global_steps)
         return True
+
+    def _ckpt_commit_heartbeat(self):
+        """Heartbeat callable handed to the background commit thread:
+        feeds the TrainingWatchdog (a slow disk is progress, not a
+        stall) and — when tracing is armed — drops one instant event per
+        fsync'd file on the ``ckpt`` lane, so the commit thread's
+        progress renders in the exported trace."""
+        wd_beat = self._watchdog.heartbeat if self._watchdog is not None \
+            else None
+        tr = self._tracer
+        if wd_beat is None and tr is None:
+            return None
+        lane = self._lane_ckpt
+
+        def beat():
+            if wd_beat is not None:
+                wd_beat()
+            if tr is not None:
+                tr.instant("ckpt_commit_beat", lane)
+
+        return beat
 
     def _arm_async_commit(self, backend):
         """True when the async commit path can carry this save; otherwise
@@ -3280,6 +3589,8 @@ class DeepSpeedEngine:
                 getattr(self, "_ckpt_foreground_ms", 0.0) \
                 + (_time.perf_counter() - t0) * 1000.0
             self._publish_ckpt_metrics()
+            if self._tracer is not None:
+                self._tracer.complete("ckpt_publish", self._lane_ckpt, t0)
         from deepspeed_tpu.runtime.resilience import chaos
         from deepspeed_tpu.runtime.resilience.atomic import gc_tags
 

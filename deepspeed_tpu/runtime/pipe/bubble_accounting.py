@@ -220,6 +220,69 @@ def bubble_report(schedule, micro_batches, stages, virtual_stages=1,
     return simulate(compiled, costs)
 
 
+# instruction kinds a telemetry trace can carry back into the simulator
+_TRACE_INSTRUCTIONS = {
+    cls.__name__: cls for cls in (
+        sched_lib.LoadMicroBatch, sched_lib.ForwardPass,
+        sched_lib.BackwardPass, sched_lib.BackwardGradPass,
+        sched_lib.BackwardWeightPass, sched_lib.SendActivation,
+        sched_lib.RecvActivation, sched_lib.SendGrad, sched_lib.RecvGrad)}
+
+
+def replay_trace(events, compiled, costs: Optional[CostModel] = None,
+                 lane_prefix="stage") -> dict:
+    """MEASURED bubble report: rebuild per-stage instruction streams from
+    a telemetry trace (the PipelineEngine interpreter records one span
+    per executed compiled instruction, lane ``stage<N>``, args
+    (chunk_id, micro_id)) and replay them through the SAME tick
+    simulation :func:`simulate` runs on the compiled plan.
+
+    This is the cross-check the analytic numbers need to be trusted:
+    ``simulate(compiled)`` prices what the schedule compiler *planned*;
+    ``replay_trace(events, compiled)`` prices what the engine *actually
+    executed*, reconstructed from its own trace.  An interpreter that
+    reorders, drops or duplicates work diverges here — faithful
+    execution reproduces the analytic idle fractions exactly (the tier-1
+    tolerance test at pipe=4/gas=8).
+
+    Raises ``ValueError`` on a trace with no pipeline spans — replaying
+    an empty stream would report a perfect zero-instruction pipeline.
+    """
+    S = compiled.stages
+    streams = [[] for _ in range(S)]
+    n = 0
+    for ev in events:
+        lane = ev.get("lane", "")
+        if not lane.startswith(lane_prefix):
+            continue
+        try:
+            s = int(lane[len(lane_prefix):])
+        except ValueError:
+            continue
+        cls = _TRACE_INSTRUCTIONS.get(ev.get("name"))
+        if cls is None or not (0 <= s < S):
+            continue
+        chunk = ev.get("a0", -1)
+        micro = ev.get("a1", -1)
+        streams[s].append(cls(buffer_id=0,
+                              chunk_id=chunk if chunk >= 0 else 0,
+                              micro_id=micro))
+        n += 1
+    if n == 0:
+        raise ValueError(
+            "replay_trace: no pipeline instruction spans in the trace "
+            f"(lanes '{lane_prefix}<N>'); was telemetry armed for the "
+            "train_batch being replayed, or did the trace ring drop "
+            "them (raise telemetry.trace_capacity)?")
+    traced = sched_lib.CompiledSchedule(
+        f"{compiled.name}-trace", compiled.micro_batches, S,
+        compiled.virtual_stages, streams, compiled.num_buffers,
+        stash=compiled.stash)
+    report = simulate(traced, costs)
+    report["replayed_instructions"] = n
+    return report
+
+
 def ideal_1f1b_bubble(micro_batches, stages):
     """Closed form (S-1)/(M+S-1) — valid for the equal_fwd_bwd cost model;
     kept as the cross-check anchor for the simulator."""

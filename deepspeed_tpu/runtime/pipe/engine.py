@@ -53,6 +53,41 @@ class StageState(NamedTuple):
     accum: object       # fp32 grad accumulator
 
 
+class _MfuJitProxy:
+    """Transparent stage-jit wrapper for the MFU ledger: on FIRST dispatch
+    it captures a ShapeDtypeStruct tree of the real args and registers a
+    lazy lower+compile with telemetry/mfu.py, then calls through.  Only
+    installed when telemetry MFU is armed — the disarmed hot path runs
+    the bare jit.  Attribute access (``.lower`` for the HLO contract
+    tests) passes through to the wrapped jit."""
+
+    # __weakref__: jax.eval_shape / linear_util cache weakref their
+    # callables (the stash-size estimate abstract-evals fwd_stash
+    # through this proxy)
+    __slots__ = ("fn", "name", "mfu", "mesh", "calls", "_registered",
+                 "__weakref__")
+
+    def __init__(self, fn, name, mfu, mesh, calls):
+        self.fn = fn
+        self.name = name
+        self.mfu = mfu
+        self.mesh = mesh
+        self.calls = calls
+        self._registered = False
+
+    def __call__(self, *args):
+        if not self._registered:
+            self._registered = True
+            from deepspeed_tpu.telemetry import register_by_shape
+
+            register_by_shape(self.mfu, self.name, self.fn, args,
+                              mesh=self.mesh, calls_per_step=self.calls)
+        return self.fn(*args)
+
+    def __getattr__(self, item):
+        return getattr(self.fn, item)
+
+
 class PipelineEngine(DeepSpeedEngine):
     """Training engine for PipelineModule models. Use train_batch/eval_batch;
     forward/backward/step are disabled (reference pipe/engine.py:1090-1098)."""
@@ -625,6 +660,19 @@ class PipelineEngine(DeepSpeedEngine):
                 jits["bwd_wgrad_stash"] = jax.jit(
                     bwd_wgrad_last_stash if is_last else bwd_wgrad_mid_stash,
                     donate_argnums=(0, 1))
+            tel = self._telemetry
+            if tel is not None and tel.mfu is not None:
+                # per-compute-jit FLOPs for the MFU ledger: fwd/bwd kinds
+                # run once per micro per chunk, the reductions/apply once
+                # per optimizer step
+                per_micro = {"fwd", "fwd_stash", "bwd_last", "bwd_mid",
+                             "bwd_dgrad", "bwd_wgrad", "bwd_dgrad_stash",
+                             "bwd_wgrad_stash"}
+                jits = {
+                    k: _MfuJitProxy(v, f"chunk{s}:{k}", tel.mfu, submesh,
+                                    gas if k in per_micro else 1.0)
+                    if (v is not None and k != "mesh") else v
+                    for k, v in jits.items()}
             self._stage_jits.append(jits)
 
     def _stash_bytes_estimate(self, sample_micro):
@@ -781,12 +829,21 @@ class PipelineEngine(DeepSpeedEngine):
 
         micros = self._collect_micros(data_iter, batch)
         self._ensure_pipe_state(micros[0])
+        if self._telemetry is not None:
+            if self._mfu_n_params is None and self.stage_states is not None:
+                self._mfu_n_params = sum(
+                    int(l.size) for st in self.stage_states
+                    for l in jax.tree_util.tree_leaves(st.params))
+            self._note_mfu_workload(micros[0],
+                                    micros_in_batch=self.micro_batches)
         self.tput_timer.start()
 
         losses, mid_auxes = self._exec_train_schedule(micros)
         self._chaos_poison_accum()
 
         # --- optimizer step (host-coordinated across stages) -----------
+        tr = self._tracer
+        _t0 = tr.begin() if tr is not None else 0.0
         lr = self._advance_lr()
         sq_total, all_finite = 0.0, True
         stats = []
@@ -833,6 +890,12 @@ class PipelineEngine(DeepSpeedEngine):
 
         self.global_steps += 1
         self.micro_steps += self.micro_batches
+        if tr is not None:
+            tr.complete("optimizer_step", self._lane_train, _t0,
+                        a0=self.global_steps)
+            if not all_finite:
+                tr.instant("overflow_skip", self._lane_train,
+                           a0=self.global_steps)
         self.tput_timer.stop()
         # one reduction + one transfer instead of gas scalar fetches
         with jax.set_mesh(self._chunk_mesh(self.num_chunks - 1)):
@@ -945,6 +1008,23 @@ class PipelineEngine(DeepSpeedEngine):
                       for i in range(self.micro_batches)]
         scale = np.float32(self._pipe_scaler.cur_scale)
         self._last_p2p_bytes = 0
+        # telemetry: one lane per PHYSICAL stage, one span per executed
+        # compiled instruction (chunk/micro in the args) — the exported
+        # trace renders the schedule, and bubble_accounting.replay_trace
+        # replays exactly these spans for the measured-vs-analytic
+        # cross-check.  The batch-begin marker scopes a replay to the
+        # LAST batch (streams of two batches would pipeline across the
+        # optimizer step the simulator doesn't model).
+        tr = self._tracer
+        if tr is not None:
+            tr_lanes = [tr.lane(f"stage{s}") for s in range(S)]
+            for n in ("LoadMicroBatch", "ForwardPass", "BackwardPass",
+                      "BackwardGradPass", "BackwardWeightPass",
+                      "SendActivation", "RecvActivation", "SendGrad",
+                      "RecvGrad"):
+                tr.intern(n, args=("chunk", "micro"))
+            tr.instant("pipe_batch_begin", self._lane_train,
+                       a0=self.global_steps)
 
         def chunk_of(cmd, s):
             return getattr(cmd, "chunk_id", 0) * S + s
@@ -1087,7 +1167,14 @@ class PipelineEngine(DeepSpeedEngine):
                 if isinstance(cmd, sched_lib.RecvGrad) and \
                         not grad_q[chunk_of(cmd, s)]:
                     continue
-                exec_cmd(cmd, s)
+                if tr is None:
+                    exec_cmd(cmd, s)
+                else:
+                    _t0 = tr.begin()
+                    exec_cmd(cmd, s)
+                    tr.complete(cmd.name, tr_lanes[s], _t0,
+                                a0=getattr(cmd, "chunk_id", 0),
+                                a1=getattr(cmd, "micro_id", -1))
                 pc[s] += 1
                 progressed = True
             if alldone:
@@ -1187,6 +1274,65 @@ class PipelineEngine(DeepSpeedEngine):
                 act_bytes_per_edge=acts, grad_bytes_per_edge=grads,
                 micro_batches=self.micro_batches)
         report["p2p"] = p2p
+        return report
+
+    def measured_bubble_report(self, costs=None):
+        """Measured-vs-analytic bubble cross-check from the telemetry
+        trace (None when tracing is disarmed; raises before the first
+        traced train_batch).
+
+        ``analytic`` simulates the compiled plan; ``measured`` replays
+        the instruction spans the interpreter actually recorded for the
+        LAST batch through the same simulator
+        (bubble_accounting.replay_trace) — faithful execution reproduces
+        the analytic per-stage idle fractions exactly, and
+        ``max_abs_idle_error`` is the tier-1-pinned drift bound.
+        ``wall_clock`` is the honest wall-time lane utilization of the
+        same spans (dispatch-bound on a CPU mesh; the transferable claim
+        is the replay)."""
+        from deepspeed_tpu.runtime.pipe import bubble_accounting as ba
+        from deepspeed_tpu.telemetry import lane_utilization
+
+        tr = self._tracer
+        if tr is None:
+            return None
+        if tr.dropped:
+            raise ValueError(
+                f"telemetry trace ring dropped {tr.dropped} events — the "
+                f"instruction stream is holey and a replay would wedge; "
+                f"raise telemetry.trace_capacity (now {tr.capacity})")
+        events = tr.events()
+        # scope to the LAST batch: streams spanning two batches would
+        # pipeline across the optimizer step the simulator doesn't model
+        last_begin = 0
+        for i, ev in enumerate(events):
+            if ev["name"] == "pipe_batch_begin":
+                last_begin = i
+        events = events[last_begin:]
+        compiled = self._ensure_compiled_schedule()
+        measured = ba.replay_trace(events, compiled, costs)
+        analytic = ba.simulate(compiled, costs)
+        lanes = {f"stage{s}" for s in range(self.num_stages)}
+        return {
+            "analytic": analytic,
+            "measured": measured,
+            "wall_clock": lane_utilization(events, lanes=lanes),
+            "max_abs_idle_error": max(
+                abs(m - a) for m, a in zip(measured["idle_fraction"],
+                                           analytic["idle_fraction"])),
+        }
+
+    def telemetry_report(self):
+        """Base unified report plus the pipeline sections: the analytic
+        ``pipeline_report()`` and — once a traced batch has run — the
+        measured-vs-analytic bubble cross-check."""
+        report = super().telemetry_report()
+        report["pipeline"] = self.pipeline_report()
+        tr = self._tracer
+        if tr is not None and not tr.dropped \
+                and any(e["name"] == "pipe_batch_begin"
+                        for e in tr.events()):
+            report["pipeline"]["measured"] = self.measured_bubble_report()
         return report
 
     # ------------------------------------------------------------------

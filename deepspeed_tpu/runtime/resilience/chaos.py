@@ -129,8 +129,34 @@ def file_written(path):
             f"chaos: killed checkpoint write after {n} files ({path})")
 
 
+# telemetry observers: called on chaos-relevant moments (commit points
+# reached, injected faults firing) so armed tracers can drop instant
+# events next to the spans they perturb.  Observers must be cheap,
+# exception-free host work; they NEVER influence the chaos plan.
+_observers = []
+
+
+def add_observer(cb):
+    """Register ``cb(kind, detail=None)``; returns cb (for removal)."""
+    _observers.append(cb)
+    return cb
+
+
+def remove_observer(cb):
+    try:
+        _observers.remove(cb)
+    except ValueError:
+        pass
+
+
+def _notify(kind, detail=None):
+    for cb in _observers:
+        cb(kind, detail)
+
+
 def point(name):
     """Called by the atomic writer at named commit points."""
+    _notify(f"point_{name}")
     if _plan is not None and _plan.kill_at_point == name:
         _plan.fired.append(("kill_at_point", name))
         raise ChaosInterrupt(f"chaos: killed checkpoint commit at {name!r}")
@@ -150,6 +176,7 @@ def serving_cancel_request(step_index):
 
 def record_serving_cancel(rid):
     """Audit one ACTUAL chaos-driven request cancellation."""
+    _notify("cancel_request", rid)
     if _plan is not None:
         with _plan._lock:
             _plan.fired.append(("cancel_request", rid))
@@ -194,6 +221,7 @@ def serving_poison_step(step_index):
 
 def record_serving_poison(rid):
     """Audit one ACTUAL poison injection (a victim lane existed)."""
+    _notify("poison_logits", rid)
     if _plan is not None:
         with _plan._lock:
             _plan.fired.append(("poison_logits", rid))

@@ -278,7 +278,7 @@ class InferenceEngine:
                  quantize_kv=False, temperature=0.0, top_k=0, top_p=0.0,
                  policy="continuous", shards=1, mesh=None,
                  axis_name="data", watchdog=None, clock=time.monotonic,
-                 reliability=None):
+                 reliability=None, telemetry=None):
         cfg = model.config
         assert not getattr(cfg, "moe_num_experts", 0), \
             "InferenceEngine serves dense blocks only: chunked prefill " \
@@ -329,6 +329,7 @@ class InferenceEngine:
         rel_cfg = reliability if isinstance(reliability, ReliabilityConfig) \
             else ReliabilityConfig(**(reliability or {}))
         self.reliability = Reliability(self, rel_cfg)
+        self._arm_telemetry(telemetry)
         S = self.max_slots
         self._tables = np.full((S, self.W), TRASH_BLOCK, np.int32)
         self._pos = np.zeros(S, np.int32)
@@ -339,6 +340,72 @@ class InferenceEngine:
         self._decode = _make_decode_step(
             cfg, self.W, self.bs, self.pool.quantized, self.temperature,
             self.top_k, self.top_p, mesh, axis_name)
+
+    def _arm_telemetry(self, spec):
+        """Arm the serving telemetry session from the ``telemetry=``
+        kwarg: ``None`` (off), a ``Telemetry`` instance, or a dict of
+        Telemetry kwargs (plus ``"enabled"``).  Disarmed serving holds
+        ``self._tracer = None`` — one attribute check per step, the
+        compiled decode surface untouched (zero recompiles pinned by the
+        telemetry test's CompilationCounter).  A config handed in with
+        ``enabled=false`` or with every channel off would observe
+        nothing, so it warns DISARMED instead of silently dropping the
+        ask."""
+        self.telemetry = None
+        self._tracer = None
+        self._owns_telemetry = False
+        self._lane_serve = 0
+        if spec is None:
+            return
+        from deepspeed_tpu.telemetry import Telemetry
+
+        if isinstance(spec, Telemetry):
+            tel = spec
+        else:
+            self._owns_telemetry = True
+            cfg = dict(spec)
+            if not cfg.pop("enabled", True):
+                logger.warning(
+                    "serving telemetry: DISARMED — a telemetry config was "
+                    "passed with enabled=false; no trace, step stream or "
+                    "MFU accounting will be produced")
+                return
+            tel = Telemetry(**cfg)
+        if tel.tracer is None and tel.stream is None and tel.mfu is None:
+            logger.warning(
+                "serving telemetry: every channel is off (trace=false, "
+                "metrics_jsonl unset, mfu=false) — effectively DISARMED")
+        self.telemetry = tel
+        self._tracer = tel.tracer
+        if self._tracer is not None:
+            self._lane_serve = self._tracer.lane("serve")
+            self._tracer.intern("serving_step", args=("step",))
+            self._tracer.intern("decode_step", args=("lanes",))
+            self._tracer.intern("admit", args=("rid",))
+
+    def export_trace(self, path, complete_events=True):
+        """Chrome-trace JSON of the retained events (None when tracing
+        is disarmed)."""
+        tr = self._tracer
+        if tr is None:
+            return None
+        return tr.export_chrome_trace(path, complete_events=complete_events)
+
+    def close_telemetry(self):
+        """Close the metrics-stream file handle of a telemetry session
+        THIS engine created from a dict spec (a caller-provided
+        ``Telemetry`` instance is the caller's to close).  Idempotent;
+        also runs at GC so bench loops never leak JSONL fds."""
+        if getattr(self, "_owns_telemetry", False) \
+                and self.telemetry is not None:
+            self.telemetry.close()
+
+    def __del__(self):
+        try:
+            self.close_telemetry()
+        except Exception:  # lint: allow-broad-except — interpreter
+            # teardown can fail imports mid-GC; never raise from __del__
+            pass
 
     # -- public API -----------------------------------------------------
     @property
@@ -409,12 +476,17 @@ class InferenceEngine:
         host-side bookkeeping on a SINGLE batched token+finiteness
         fetch, and the journal's step-boundary commit."""
         self._step_idx += 1
+        tr = self._tracer
+        _t0 = tr.begin() if tr is not None else 0.0
         slow = chaos.serving_slow_step_s(self._step_idx)
         if slow:
             time.sleep(slow)
         if self._watchdog is not None:
             self._watchdog.observe_serving_step(self._step_idx)
         if self._drain_requested:
+            if tr is not None and not self.scheduler.draining:
+                tr.instant("drain_requested", self._lane_serve,
+                           a0=self._step_idx)
             self.scheduler.draining = True
         events = {"admitted": [], "finished": [], "evicted": [],
                   "cancelled": [], "expired": [], "budget": [],
@@ -422,11 +494,27 @@ class InferenceEngine:
         rid = self.scheduler.chaos_cancel()
         if rid is not None and self.cancel(rid):
             events["cancelled"].append(rid)
-        self._enforce_deadlines(events)
-        self._prefill_tick(events)
-        decoded = self._decode_tick(events)
+        if tr is None:
+            self._enforce_deadlines(events)
+            self._prefill_tick(events)
+            decoded = self._decode_tick(events)
+        else:
+            _t = tr.begin()
+            self._enforce_deadlines(events)
+            tr.complete("deadline_sweep", self._lane_serve, _t)
+            _t = tr.begin()
+            self._prefill_tick(events)
+            tr.complete("prefill_tick", self._lane_serve, _t)
+            _t = tr.begin()
+            decoded = self._decode_tick(events)
+            tr.complete("decode_step", self._lane_serve, _t, a0=decoded)
+            for rid_ in events["admitted"]:
+                tr.instant("admit", self._lane_serve, a0=rid_)
         self.scheduler.on_drained()
         self.reliability.on_step_end()
+        if tr is not None and self.reliability.journal is not None:
+            tr.instant("journal_commit", self._lane_serve,
+                       a0=self.reliability.journal_depth())
         occ = self.pool.occupancy()
         frag = self.pool.fragmentation()
         qd = self.scheduler.queue_depth()
@@ -446,6 +534,11 @@ class InferenceEngine:
             "journal_depth": rel.journal_depth(),
             "draining": self.scheduler.draining,
         }
+        if tr is not None:
+            tr.complete("serving_step", self._lane_serve, _t0,
+                        a0=self._step_idx)
+        if self.telemetry is not None and not self._warming:
+            self.telemetry.on_step(self._step_idx, self._last_metrics)
         return events
 
     def serve(self, *, max_steps=100000) -> dict:
@@ -469,6 +562,9 @@ class InferenceEngine:
         handler safe: only sets a flag (the PR 7
         ``request_preemption`` idiom)."""
         self._drain_requested = True
+        # NOTE: no tracer event here — this runs in signal-handler
+        # context and the tracer takes a lock; the step loop emits the
+        # drain instant at the next (safe) step boundary instead
 
     def install_preemption_handler(self, signals=None) -> None:
         """Route SIGTERM (the preemption notice on TPU pods) into
@@ -530,6 +626,9 @@ class InferenceEngine:
             rids.append(rid)
             max_rid = max(max_rid, rid)
         self._rids = itertools.count(max_rid + 1)
+        if self._tracer is not None:
+            self._tracer.instant("recover", self._lane_serve,
+                                 a0=len(rids))
         logger.info("recover: re-submitted %d journaled requests from %s",
                     len(rids), journal_path)
         return rids
@@ -598,6 +697,47 @@ class InferenceEngine:
         }
         rep["kv_pool"]["now"] = self.pool.stats()
         rep["reliability"] = self.reliability.report()
+        return rep
+
+    def telemetry_report(self) -> dict:
+        """Unified observability report (the serving face of the training
+        engines' ``telemetry_report()``): the full legacy
+        ``serving_report()`` plus the telemetry sections — metrics
+        registry snapshot, trace summary, and the decode MFU/HFU ledger
+        (``mfu``, populated from the decode jit's
+        ``cost_analysis()``)."""
+        rep = self.serving_report()
+        tel = self.telemetry
+        # same top-level schema as the training engines' report
+        # (telemetry_armed/metrics/trace/mfu) so shared consumers never
+        # branch on engine type; the nested "telemetry" section mirrors
+        # them for back-compat
+        rep["telemetry_armed"] = tel is not None
+        rep["telemetry"] = {"armed": tel is not None}
+        if tel is None:
+            return rep
+        rep["metrics"] = rep["telemetry"]["metrics"] = \
+            tel.registry.snapshot()
+        if tel.tracer is not None:
+            rep["trace"] = rep["telemetry"]["trace"] = \
+                tel.tracer.summary()
+        if tel.mfu is not None:
+            from deepspeed_tpu.telemetry import model_flops_per_step
+
+            n_params = sum(
+                int(l.size)
+                for l in jax.tree_util.tree_leaves(self.params))
+            # decode model FLOPs: 2ND forward-only over every dispatched
+            # lane (idle lanes still compute — multiply by
+            # slot_utilization for a goodput-adjusted MFU)
+            rep["mfu"] = tel.mfu.report(
+                step_time_s=self.metrics.step_time() or tel.step_time_s(),
+                n_devices=max(1, self.shards),
+                model_flops=model_flops_per_step(n_params, self.max_slots,
+                                                 fwd_only=True),
+                device_kind=getattr(jax.devices()[0], "device_kind", None))
+            rep["mfu"]["n_params"] = n_params
+            rep["mfu"]["tokens_per_step"] = self.max_slots
         return rep
 
     def decode_hlo(self) -> str:
@@ -687,6 +827,9 @@ class InferenceEngine:
         request can never wedge the shared decode batch."""
         self.scheduler.finish(req, reason)
         self._cleanup(req, reason)
+        if self._tracer is not None:
+            self._tracer.instant(f"abort_{reason}", self._lane_serve,
+                                 a0=req.rid)
         if events is not None and reason in events:
             events[reason].append(req.rid)
 
@@ -805,6 +948,18 @@ class InferenceEngine:
         for slot, req in running.items():
             self._tables[slot] = self.pool.table_row(req.rid, self.W)
             req.work_done += 1
+        tel = self.telemetry
+        if tel is not None:
+            # capture-by-shape BEFORE dispatch (the pool is donated by
+            # it); the lower+compile runs lazily at report time, outside
+            # any recompile-guard window
+            from deepspeed_tpu.telemetry import register_by_shape
+
+            register_by_shape(
+                tel.mfu, "decode_step", self._decode,
+                (self.params, *self.pool.tensors.arrays, self._tables,
+                 self._pos, self._tok, self._active, self._seeds,
+                 self._poison))
         out = self._decode(self.params, *self.pool.tensors.arrays,
                            self._tables, self._pos, self._tok,
                            self._active, self._seeds, self._poison)

@@ -15,6 +15,8 @@ warmed engine) must count ZERO inside the guard window.
 import time
 from typing import Dict, List
 
+from deepspeed_tpu.telemetry.metrics import Histogram, nearest_rank
+
 _MONITORING_KEY = "backend_compile"
 _counters: List["CompilationCounter"] = []
 _listener_installed = False
@@ -63,12 +65,13 @@ def _pct(xs, q):
     """Nearest-rank percentile, total over its edge cases: empty input
     is ``None`` (never raises), a single sample IS every percentile,
     and q is clamped to [0, 1] — the overload guard reads p50/p95 off
-    arbitrary slices of a run, including before the first token."""
-    if not xs:
-        return None
-    s = sorted(xs)
-    q = min(1.0, max(0.0, q))
-    return s[min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))]
+    arbitrary slices of a run, including before the first token.
+
+    Delegates to the repo-wide shared implementation
+    (``telemetry.metrics.nearest_rank``, the same one the telemetry
+    ``Histogram`` percentiles use) — the edge-case contract above is
+    pinned by test_serving_reliability.py and test_telemetry.py."""
+    return nearest_rank(xs, q)
 
 
 class ServingMetrics:
@@ -97,9 +100,12 @@ class ServingMetrics:
         self.total_tokens = 0          # generated tokens, all requests
         self.useful_tokens = 0         # tokens of requests that FINISHED
         self.wasted_tokens = 0         # tokens of aborted/shed/cancelled reqs
-        self._queue_depth: List[int] = []
-        self._occupancy: List[float] = []
-        self._fragmentation: List[float] = []
+        # per-step utilization series ride the shared telemetry
+        # Histogram (bounded reservoir; count/mean/max exact over the
+        # whole run) instead of three ad-hoc unbounded lists
+        self._queue_depth = Histogram()
+        self._occupancy = Histogram()
+        self._fragmentation = Histogram()
         self._t0 = None
         self._t_end = None
         self._step_dt_ema = None       # EMA of inter-step wall time
@@ -152,9 +158,9 @@ class ServingMetrics:
             self.decode_steps += 1
             self.slot_steps += slots
             self.active_slot_steps += running
-        self._queue_depth.append(queue_depth)
-        self._occupancy.append(occupancy)
-        self._fragmentation.append(fragmentation)
+        self._queue_depth.add(queue_depth)
+        self._occupancy.add(occupancy)
+        self._fragmentation.add(fragmentation)
 
     # -- summary --------------------------------------------------------
     def step_time(self):
@@ -210,9 +216,12 @@ class ServingMetrics:
                 if self.slot_steps else None,
             },
             "steps": {"total": self.steps, "decode": self.decode_steps},
-            "queue_depth": {"mean": _mean(self._queue_depth),
-                            "max": max(self._queue_depth, default=0)},
-            "kv_pool": {"occupancy_mean": _mean(self._occupancy),
-                        "occupancy_max": max(self._occupancy, default=0.0),
-                        "fragmentation_mean": _mean(self._fragmentation)},
+            "queue_depth": {"mean": self._queue_depth.mean(),
+                            "max": self._queue_depth.max()
+                            if self._queue_depth.count else 0,
+                            "p95": self._queue_depth.pct(.95)},
+            "kv_pool": {"occupancy_mean": self._occupancy.mean(),
+                        "occupancy_max": self._occupancy.max()
+                        if self._occupancy.count else 0.0,
+                        "fragmentation_mean": self._fragmentation.mean()},
         }

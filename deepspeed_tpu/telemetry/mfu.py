@@ -1,0 +1,189 @@
+"""MFU/HFU accounting from compiled-program cost analysis.
+
+Two FLOP ledgers, reported side by side because they answer different
+questions:
+
+- **model FLOPs** (``model_flops_per_step``): the 6ND forward+backward
+  formula (2ND forward-only for serving decode) — what the model
+  mathematically requires.  ``MFU = model_flops / (step_time × devices
+  × peak)``; remat recompute and padding never inflate it (the same
+  convention as bench.py's TFLOPS claims).
+- **hardware FLOPs**: summed ``compiled.cost_analysis()["flops"]`` over
+  every registered jitted program × its calls per step — what XLA
+  actually scheduled, including remat recompute, so
+  ``HFU >= MFU`` and the gap IS the recompute/padding tax.
+
+Registration is capture-by-shape: engines register a zero-arg
+``make_compiled`` closure (built from ``jax.ShapeDtypeStruct`` trees of
+the real dispatch args, under the engine's mesh) the FIRST time a jit
+dispatches, and the closure is only invoked lazily at report time —
+``lower().compile()`` on shape structs never touches donated buffers
+and never runs device code, but it IS a compile, so it stays off the
+hot path and outside any recompile-guard window.
+
+Peak FLOPS resolution: an explicit ``peak_tflops_per_device`` config
+wins; otherwise the device-kind table below (the bench.py table, bf16
+peaks); unknown kinds (CPU meshes) report achieved FLOPS with
+``mfu``/``hfu`` = None rather than a ratio against a guessed peak.
+"""
+import threading
+
+import numpy as np
+
+# bf16 peak TFLOPS per chip by device-kind substring (bench.py's table —
+# kept in sync by tests/unit/test_telemetry.py)
+PEAK_TFLOPS_TABLE = [
+    ("v6e", 918.0), ("v6", 918.0),
+    ("v5p", 459.0), ("v5e", 197.0), ("v5lite", 197.0), ("v5", 459.0),
+    ("v4", 275.0), ("v3", 123.0), ("v2", 45.0),
+]
+
+
+def peak_flops_per_device(device_kind):
+    """(peak FLOPS/s per device, known) for a device-kind string."""
+    kind = (device_kind or "").lower().replace(" ", "")
+    for key, peak in PEAK_TFLOPS_TABLE:
+        if key in kind:
+            return peak * 1e12, True
+    return None, False
+
+
+def normalize_cost_analysis(compiled):
+    """``compiled.cost_analysis()`` → ``{"flops", "bytes_accessed"}``.
+
+    jax has returned the analysis as a dict, a list of one dict, and (on
+    some backends) nothing useful; missing keys come back as None so
+    callers can report honestly instead of crashing on a backend quirk.
+    """
+    try:
+        ca = compiled.cost_analysis()
+    except (AttributeError, NotImplementedError, RuntimeError) as e:
+        return {"flops": None, "bytes_accessed": None, "error": str(e)}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {"flops": None, "bytes_accessed": None}
+    flops = ca.get("flops")
+    nbytes = ca.get("bytes accessed", ca.get("bytes_accessed"))
+    return {"flops": float(flops) if flops is not None else None,
+            "bytes_accessed": float(nbytes) if nbytes is not None else None}
+
+
+def model_flops_per_step(n_params, tokens_per_step, fwd_only=False):
+    """The dense-transformer FLOP formula: 6ND fwd+bwd, 2ND fwd-only."""
+    return (2.0 if fwd_only else 6.0) * float(n_params) \
+        * float(tokens_per_step)
+
+
+def register_by_shape(mfu, name, jit_fn, args, mesh=None,
+                      calls_per_step=1.0):
+    """THE capture-by-shape registration every engine uses: take a
+    ``jax.ShapeDtypeStruct`` tree of the REAL dispatch args NOW (donated
+    buffers still alive, non-array leaves coerced through numpy) and
+    register a lazy ``lower().compile()`` closure — run once, at report
+    time, under ``mesh`` when one is given — so the compile never lands
+    on the step path or inside a recompile-guard window.  No-op when
+    ``mfu``/``jit_fn`` is None or ``name`` is already registered."""
+    if mfu is None or jit_fn is None or mfu.has(name):
+        return
+    import jax
+
+    structs = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+        if hasattr(x, "dtype")
+        else jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        args)
+
+    def make_compiled():
+        if mesh is None:
+            return jit_fn.lower(*structs).compile()
+        with jax.set_mesh(mesh):
+            return jit_fn.lower(*structs).compile()
+
+    mfu.register(name, make_compiled, calls_per_step)
+
+
+class MfuAccounting:
+    """Per-jit FLOPs/bytes registry + MFU/HFU report builder."""
+
+    def __init__(self, peak_tflops_per_device=0.0):
+        # explicit peak (TFLOPS) overrides device-kind lookup; 0 = auto
+        self.peak_tflops_per_device = float(peak_tflops_per_device or 0.0)
+        self._jits = {}        # name -> (make_compiled, calls_per_step)
+        self._costs = {}       # name -> normalized cost dict (lazy)
+        self._lock = threading.Lock()
+
+    def has(self, name):
+        return name in self._jits
+
+    def register(self, name, make_compiled, calls_per_step=1.0):
+        """Register one jitted program.  ``make_compiled`` is a zero-arg
+        callable returning the compiled object (typically
+        ``lambda: jit_fn.lower(*shape_structs).compile()`` under the
+        engine's mesh); it runs lazily, once, at report time."""
+        with self._lock:
+            if name not in self._jits:
+                self._jits[name] = (make_compiled, float(calls_per_step))
+
+    def costs(self):
+        """{name: {flops, bytes_accessed, calls_per_step}} — compiled
+        lazily on first call, cached after.  A program whose lowering
+        fails reports its error string instead of poisoning the rest."""
+        with self._lock:
+            jits = dict(self._jits)
+        for name, (make_compiled, calls) in jits.items():
+            if name in self._costs:
+                continue
+            try:
+                cost = normalize_cost_analysis(make_compiled())
+            except Exception as e:  # lint: allow-broad-except — one
+                # program's lowering quirk must not kill the report
+                cost = {"flops": None, "bytes_accessed": None,
+                        "error": f"{type(e).__name__}: {e}"}
+            cost["calls_per_step"] = calls
+            self._costs[name] = cost
+        return dict(self._costs)
+
+    def hw_flops_per_step(self):
+        total, complete = 0.0, True
+        for cost in self.costs().values():
+            if cost["flops"] is None:
+                complete = False
+                continue
+            total += cost["flops"] * cost["calls_per_step"]
+        return (total if total > 0 else None), complete
+
+    def report(self, *, step_time_s, n_devices, model_flops=None,
+               device_kind=None):
+        """The ``telemetry_report()["mfu"]`` section.  ``model_flops``
+        is per step, all devices; ``step_time_s`` mean seconds per
+        optimizer/serving step."""
+        hw_flops, complete = self.hw_flops_per_step()
+        if self.peak_tflops_per_device > 0:
+            peak, peak_known = self.peak_tflops_per_device * 1e12, True
+        else:
+            peak, peak_known = peak_flops_per_device(device_kind)
+        denom = None
+        if step_time_s and step_time_s > 0 and n_devices:
+            denom = step_time_s * n_devices
+        out = {
+            "per_jit": self.costs(),
+            "hw_flops_per_step": hw_flops,
+            "hw_flops_complete": complete,
+            "model_flops_per_step": model_flops,
+            "step_time_s": step_time_s,
+            "n_devices": n_devices,
+            "device_kind": device_kind,
+            "peak_flops_per_device": peak,
+            "peak_known": peak_known,
+            "achieved_tflops_per_device":
+                (model_flops / denom / 1e12)
+                if (denom and model_flops) else None,
+            "achieved_hw_tflops_per_device":
+                (hw_flops / denom / 1e12) if (denom and hw_flops) else None,
+            "mfu": (model_flops / (denom * peak))
+            if (denom and model_flops and peak) else None,
+            "hfu": (hw_flops / (denom * peak))
+            if (denom and hw_flops and peak) else None,
+        }
+        return out

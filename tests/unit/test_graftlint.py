@@ -829,3 +829,67 @@ def test_bare_except_rule_matches_legacy_checker():
     src_ok = ("try:\n    x()\n"
               "except Exception:  # lint: allow-broad-except\n    pass\n")
     assert lint(src_ok, rules=["bare-except"]) == []
+
+
+# ---------------------------------------------------------------------------
+# telemetry coverage (ISSUE 10): hot-file host-sync + _arm_telemetry
+# discipline
+# ---------------------------------------------------------------------------
+
+# span emit that pays a device round-trip per recorded event — the
+# exact failure mode the telemetry host-sync bar exists to catch
+TELEMETRY_HS_BAD = """
+def record_spans(tracer, lane, arrays, jax):
+    for a in arrays:
+        tracer.complete("fetch", lane, float(jax.device_get(a)))
+"""
+
+# fixed twin: one batched fetch after the loop, spans from host floats
+TELEMETRY_HS_GOOD = """
+def record_spans(tracer, lane, arrays, jax):
+    ts = jax.device_get(arrays)
+    for t in ts:
+        tracer.complete("fetch", lane, float(t))
+"""
+
+
+@pytest.mark.parametrize("path", ["deepspeed_tpu/telemetry/trace.py",
+                                  "deepspeed_tpu/telemetry/metrics.py",
+                                  "deepspeed_tpu/telemetry/mfu.py"])
+def test_host_sync_fires_in_telemetry_loop(path):
+    got = lint(TELEMETRY_HS_BAD, path, rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"]
+    assert "per-iteration loop" in got[0].message
+
+
+def test_host_sync_quiet_on_batched_telemetry_emit():
+    assert lint(TELEMETRY_HS_GOOD, "deepspeed_tpu/telemetry/trace.py",
+                rules=["host-sync"]) == []
+
+
+def test_host_sync_telemetry_scope_is_telemetry_files_only():
+    # the same loop in a non-hot module is plain host code
+    assert lint(TELEMETRY_HS_BAD, "deepspeed_tpu/utils/foo.py",
+                rules=["host-sync"]) == []
+
+
+ARM_TELEMETRY_BAD = """
+class E:
+    def _arm_telemetry(self):
+        self._telemetry = None
+        if self.config.telemetry_enabled:
+            self._telemetry = build_session(self.config)
+"""
+
+ARM_TELEMETRY_GOOD = ARM_TELEMETRY_BAD + """
+        elif self.config.metrics_jsonl:
+            log_dist("telemetry: DISARMED — metrics_jsonl set but "
+                     "telemetry.enabled=false", ranks=[0],
+                     level=logging.WARNING)
+"""
+
+
+def test_disarmed_discipline_covers_arm_telemetry():
+    got = lint(ARM_TELEMETRY_BAD, rules=["disarmed-discipline"])
+    assert rule_names(got) == ["disarmed-discipline"]
+    assert lint(ARM_TELEMETRY_GOOD, rules=["disarmed-discipline"]) == []

@@ -59,6 +59,14 @@ HOT_FN_RE = re.compile(
 # benchmark drivers: every loop is (or brackets) a timed region — a sync
 # per iteration pollutes the measured step time with transfer latency
 BENCH_FILES = {"bench.py", "tools/pipe_bench.py", "tools/serve_bench.py"}
+# telemetry: the whole package is hot-path by contract (span emit runs
+# once per instruction/step inside the engines' dispatch loops, and the
+# armed-overhead bound is a tier-1 test) — every function is held to the
+# bench-file bar: a device sync in ANY loop is a finding
+TELEMETRY_FILES = {"deepspeed_tpu/telemetry/trace.py",
+                   "deepspeed_tpu/telemetry/metrics.py",
+                   "deepspeed_tpu/telemetry/mfu.py",
+                   "deepspeed_tpu/telemetry/__init__.py"}
 
 # cold-path builders: O(param-leaves) host work (tree flattening, shape
 # math, spec construction) that belongs at arming/compile time.  A call
@@ -177,12 +185,14 @@ class HostSyncRule(Rule):
                     "fails on a tracer or forces a per-call device sync; "
                     "move it outside the traced body")
 
-        # --- hot-loop context (engine step paths + bench timed regions) -
-        if path in HOT_FILES or path in BENCH_FILES:
+        # --- hot-loop context (engine step paths + bench/telemetry) ----
+        if path in HOT_FILES or path in BENCH_FILES \
+                or path in TELEMETRY_FILES:
             hot_fns = []
             for n in ast.walk(tree):
                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                         and (path in BENCH_FILES
+                             or path in TELEMETRY_FILES
                              or HOT_FN_RE.match(n.name)):
                     hot_fns.append(n)
             for fn in hot_fns:

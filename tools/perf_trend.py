@@ -1,0 +1,207 @@
+"""Perf trajectory across bench rounds: step-time / MFU / comm-bytes
+trends over ``BENCH_r*.json``, with a regression gate.
+
+ROADMAP item 5 asks that MFU be *trended* across rounds instead of
+eyeballed per round; rounds r02/r04/r05 historically died before
+publishing anything, so the trend must also be honest about dead rounds
+(they appear as gaps, never as zeros averaged into a slope).
+
+Usage::
+
+    python -m tools.perf_trend                    # table + JSON summary
+    python -m tools.perf_trend --check            # exit 1 on regression
+    python -m tools.perf_trend --threshold 0.05   # tighten the gate
+
+Regression rule: compare the newest successful round against the best
+previous successful round **with the same metric string** (rounds that
+measured different things — stage-3 A/B vs dense TFLOPS — are not
+comparable and never gate each other).  ``value`` dropping more than
+``threshold`` (default 10%, the comm_budgets.json convention) fails;
+``mfu``/``tokens_per_sec`` ride along in the report for context.
+
+``trend_payload(latest=...)`` is the bench.py hook: it returns the same
+summary with an optional not-yet-written payload appended, so every
+bench round prints where it stands relative to history.
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+DEFAULT_GLOB = "BENCH_r*.json"
+DEFAULT_THRESHOLD = 0.10
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def _unwrap(payload):
+    """BENCH_r*.json files come in two shapes: the bench payload itself,
+    or the round driver's wrapper ``{"n", "cmd", "rc", "tail"}`` whose
+    ``tail`` holds the worker's (possibly truncated) stdout.  Pull the
+    last parseable JSON-object line out of the tail; a truncated or
+    absent payload is a dead round (None)."""
+    if not isinstance(payload, dict):
+        return None
+    if "value" in payload or "metric" in payload:
+        return payload
+    tail = payload.get("tail")
+    if not isinstance(tail, str):
+        return None
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            inner = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(inner, dict) and "value" in inner:
+            return inner
+    return None
+
+
+def load_rounds(pattern=DEFAULT_GLOB, root="."):
+    """[(round_number, path, payload-or-None)] sorted by round number.
+    Unreadable/non-object/truncated payloads load as None (a dead round
+    is a visible gap, not a crash)."""
+    out = []
+    for path in glob.glob(os.path.join(root, pattern)):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                payload = _unwrap(json.load(f))
+        except (OSError, ValueError):
+            payload = None
+        out.append((int(m.group(1)), path, payload))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def _ok(payload):
+    return (payload is not None and "error" not in payload
+            and isinstance(payload.get("value"), (int, float))
+            and payload.get("value", 0) > 0)
+
+
+def trend_rows(rounds):
+    """One row per round: the trended scalars plus the telemetry artifact
+    paths (trace + metrics JSONL) the round left behind."""
+    rows = []
+    for rnum, path, payload in rounds:
+        row = {"round": rnum, "path": path, "ok": _ok(payload)}
+        if payload is not None:
+            tel = payload.get("telemetry") or {}
+            mfu_rep = tel.get("mfu") or {}
+            row.update({
+                "metric": payload.get("metric"),
+                "value": payload.get("value"),
+                "unit": payload.get("unit"),
+                "mfu": payload.get("mfu"),
+                "hfu": mfu_rep.get("hfu"),
+                "step_ms": payload.get("step_ms"),
+                "tokens_per_sec": payload.get("tokens_per_sec"),
+                "trace": tel.get("trace"),
+                "metrics_jsonl": tel.get("metrics_jsonl"),
+            })
+        rows.append(row)
+    return rows
+
+
+def check_regression(rows, threshold=DEFAULT_THRESHOLD):
+    """Regression verdict dict for the newest successful row vs the best
+    earlier successful row with the SAME metric string.  ``regressed``
+    is False when fewer than two comparable rounds exist."""
+    ok_rows = [r for r in rows if r["ok"]]
+    verdict = {"regressed": False, "threshold": threshold,
+               "latest": None, "baseline": None, "comparable_rounds": 0}
+    if not ok_rows:
+        return verdict
+    latest = ok_rows[-1]
+    verdict["latest"] = {"round": latest["round"], "value": latest["value"],
+                         "mfu": latest.get("mfu")}
+    peers = [r for r in ok_rows[:-1] if r.get("metric") == latest["metric"]]
+    verdict["comparable_rounds"] = len(peers)
+    if not peers:
+        return verdict
+    best = max(peers, key=lambda r: r["value"])
+    verdict["baseline"] = {"round": best["round"], "value": best["value"],
+                           "mfu": best.get("mfu")}
+    verdict["ratio"] = latest["value"] / best["value"] if best["value"] \
+        else None
+    verdict["regressed"] = latest["value"] < best["value"] * (1 - threshold)
+    return verdict
+
+
+def trend_payload(pattern=DEFAULT_GLOB, root=".",
+                  threshold=DEFAULT_THRESHOLD, latest=None):
+    """The summary bench.py embeds in its output JSON: compact per-round
+    history + the regression verdict.  ``latest`` (a payload dict not yet
+    on disk — the round being printed) is appended as a synthetic round
+    after the newest on-disk one."""
+    rounds = load_rounds(pattern, root)
+    if latest is not None:
+        nxt = (rounds[-1][0] + 1) if rounds else 1
+        rounds = rounds + [(nxt, "<current>", latest)]
+    rows = trend_rows(rounds)
+    return {
+        "rounds": [{k: r.get(k) for k in
+                    ("round", "ok", "value", "unit", "mfu", "step_ms",
+                     "tokens_per_sec")} for r in rows],
+        "dead_rounds": [r["round"] for r in rows if not r["ok"]],
+        "regression": check_regression(rows, threshold),
+    }
+
+
+def _fmt(v, nd=2):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Trend step-time/MFU across BENCH_r*.json rounds")
+    p.add_argument("--glob", default=DEFAULT_GLOB)
+    p.add_argument("--root", default=".")
+    p.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD)
+    p.add_argument("--check", action="store_true",
+                   help="exit 1 when the newest successful round regressed "
+                        ">threshold vs the best comparable round")
+    p.add_argument("--json", action="store_true",
+                   help="print the summary as JSON only")
+    args = p.parse_args(argv)
+
+    rows = trend_rows(load_rounds(args.glob, args.root))
+    verdict = check_regression(rows, args.threshold)
+    summary = {"rounds": rows, "regression": verdict}
+    if args.json:
+        print(json.dumps(summary, indent=1))
+    else:
+        print(f"{'round':>5} {'ok':>3} {'value':>10} {'mfu':>7} "
+              f"{'step_ms':>9} {'tok/s':>12}  metric")
+        for r in rows:
+            print(f"{r['round']:>5} {'y' if r['ok'] else 'n':>3} "
+                  f"{_fmt(r.get('value')):>10} {_fmt(r.get('mfu'), 4):>7} "
+                  f"{_fmt(r.get('step_ms'), 1):>9} "
+                  f"{_fmt(r.get('tokens_per_sec'), 0):>12}  "
+                  f"{(r.get('metric') or '-')[:60]}")
+        if verdict["baseline"]:
+            word = "REGRESSED" if verdict["regressed"] else "ok"
+            print(f"\nlatest r{verdict['latest']['round']} vs best "
+                  f"comparable r{verdict['baseline']['round']}: "
+                  f"ratio={_fmt(verdict.get('ratio'), 3)} "
+                  f"(threshold {args.threshold:.0%}) -> {word}")
+        else:
+            print("\nno comparable prior round — nothing to gate")
+    if args.check and verdict["regressed"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -413,6 +413,58 @@ def serving_decode_collectives(
     return out
 
 
+def serving_kv_handoff_collectives(
+        n_layer: int, n_head: int, head_dim: int, *, blocks: int,
+        block_size: int, kv_dtype: str = "float32",
+        quantized: bool = False,
+        name: str = "kv_handoff") -> List[Collective]:
+    """Price ONE paged-block KV handoff between serving replicas — the
+    disaggregated prefill/decode transfer of PAPERS.md 2601.02311.
+
+    Prefill is compute-bound and bursty, decode is memory-bound and
+    steady, so a fleet provisions them separately; the cost of the
+    split is moving a finished prompt's KV ONCE from the prefill
+    replica's pool to a decode replica's.  The payload is exactly the
+    request's allocated blocks in the pool layout that already
+    round-trips through checkpoints — ``blocks`` blocks of
+    ``(n_layer, n_head, block_size, head_dim)`` rows for K and V each
+    (the fixed-width page-table padding is an implementation detail of
+    the fixed-shape gather, not wire payload).  int8 pools move int8
+    payloads plus the per-(token, head) f32 scale rows, matching
+    ``kv_cache``'s quantized layout byte-for-byte.
+
+    A handoff is a point-to-point copy (the pipe-p2p convention): the
+    sender puts the FULL payload on the wire, no ring discount.  The
+    alternative this prices against is RE-PREFILLING prompt+generated
+    at the destination — zero wire bytes but one full prefill of
+    compute; ``serving/fleet.py`` reports both so the trade is visible
+    per workload."""
+    rows = n_layer * blocks * n_head * block_size
+    elems = rows * head_dim
+    dtype = "int8" if quantized else kv_dtype
+    es = DTYPE_BYTES[dtype]
+    out = [Collective(
+        name=f"p2p_kv:{name}", op="p2p", dtype=dtype,
+        elements=2 * elems, axis_size=2,
+        bytes_per_device=2 * elems * es)]
+    if quantized:
+        out.append(Collective(
+            name=f"p2p_kv_scales:{name}", op="p2p", dtype="float32",
+            elements=2 * rows, axis_size=2,
+            bytes_per_device=2 * rows * 4))
+    return out
+
+
+def serving_kv_handoff_bytes(n_layer: int, n_head: int, head_dim: int, *,
+                             blocks: int, block_size: int,
+                             kv_dtype: str = "float32",
+                             quantized: bool = False) -> int:
+    """Total wire bytes of one KV handoff (sum over its collectives)."""
+    return sum(c.bytes_per_device for c in serving_kv_handoff_collectives(
+        n_layer, n_head, head_dim, blocks=blocks, block_size=block_size,
+        kv_dtype=kv_dtype, quantized=quantized))
+
+
 def zero_shard_dim(shape: Sequence[int], dp: int,
                    taken: Sequence[int] = ()) -> Optional[int]:
     """The dimension mesh.zero_merge_spec would shard over 'data': the

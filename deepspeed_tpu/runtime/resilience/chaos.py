@@ -28,7 +28,9 @@ class ChaosPlan:
                  preempt_after_steps=0, kill_serving_after_steps=0,
                  slow_serving_step_every=0, slow_serving_step_s=0.05,
                  poison_logits_at_step=0, burst_arrival_every=0,
-                 burst_arrival_count=0):
+                 burst_arrival_count=0, kill_replica_after_steps=0,
+                 kill_replica=0, slow_replica_step_every=0,
+                 slow_replica=0, slow_replica_step_s=0.05):
         self.kill_after_files = kill_after_files
         self.kill_at_point = kill_at_point
         self.corrupt_after_files = corrupt_after_files
@@ -42,6 +44,11 @@ class ChaosPlan:
         self.poison_logits_at_step = poison_logits_at_step
         self.burst_arrival_every = burst_arrival_every
         self.burst_arrival_count = burst_arrival_count
+        self.kill_replica_after_steps = kill_replica_after_steps
+        self.kill_replica = kill_replica
+        self.slow_replica_step_every = slow_replica_step_every
+        self.slow_replica = slow_replica
+        self.slow_replica_step_s = slow_replica_step_s
         self.files_written = 0
         self.fired = []
         self._lock = threading.Lock()
@@ -84,6 +91,17 @@ def arm(**kwargs):
     burst_arrival_every=N, burst_arrival_count=K  release K extra request
                          arrivals every Nth serving step (thundering-herd
                          traffic; drivers query serving_burst()).
+    kill_replica_after_steps=N, kill_replica=R  hard-down one FLEET
+                         replica: raise ChaosInterrupt mid-decode on
+                         EVERY step >= N of replica R (unlike the
+                         one-shot kill_serving latch — a dead host fails
+                         every retry, which is what the router's
+                         circuit breaker must observe to mark it dead).
+    slow_replica_step_every=N, slow_replica=R, slow_replica_step_s=S
+                         sleep S seconds in every Nth step of fleet
+                         replica R only (one wedged host in an otherwise
+                         healthy fleet; feeds that replica's stall
+                         detector without touching its peers).
     """
     global _plan
     _plan = ChaosPlan(**kwargs)
@@ -239,6 +257,47 @@ def serving_burst(step_index):
     with _plan._lock:
         _plan.fired.append(("burst_arrival", step_index))
     return _plan.burst_arrival_count
+
+
+def fleet_kill_replica_step(replica_index, step_index):
+    """Hard-down replica simulation: raises ChaosInterrupt MID-DECODE
+    (after the dispatch, before any host bookkeeping — the same crash
+    point as ``serving_kill_step``) on EVERY step >= N of the armed
+    replica.  Unlike the single-engine kill's one-shot latch, a downed
+    host keeps failing, so the fleet router's bounded retry/backoff
+    exhausts its circuit breaker and marks the replica dead.  No-op for
+    other replicas and for engines that are not fleet-tagged
+    (``replica_index is None``)."""
+    if _plan is None or not _plan.kill_replica_after_steps \
+            or replica_index is None:
+        return
+    if replica_index != _plan.kill_replica \
+            or step_index < _plan.kill_replica_after_steps:
+        return
+    with _plan._lock:
+        _plan.fired.append(("kill_replica", (replica_index, step_index)))
+    _notify("kill_replica", replica_index)
+    raise ChaosInterrupt(
+        f"chaos: fleet replica {replica_index} killed mid-decode at "
+        f"step {step_index}")
+
+
+def fleet_slow_replica_s(replica_index, step_index):
+    """Seconds to stall this step of ONE fleet replica (0.0 = not this
+    replica / nothing armed) — the per-replica analog of
+    ``serving_slow_step_s`` that lets a fleet test wedge a single host
+    while its peers keep serving."""
+    if _plan is None or not _plan.slow_replica_step_every \
+            or replica_index is None:
+        return 0.0
+    if replica_index != _plan.slow_replica:
+        return 0.0
+    if step_index % _plan.slow_replica_step_every:
+        return 0.0
+    with _plan._lock:
+        _plan.fired.append(("slow_replica", (replica_index, step_index)))
+    _notify("slow_replica", replica_index)
+    return _plan.slow_replica_step_s
 
 
 def consume_preempt_step():

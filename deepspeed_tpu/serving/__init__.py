@@ -12,9 +12,15 @@
   occupancy, exposed via ``InferenceEngine.serving_report()``;
 - :mod:`reliability` — deadlines/work budgets, SLO-aware admission and
   load shedding, graceful drain, the crash-recovery request journal,
-  and per-request poison quarantine.
+  and per-request poison quarantine;
+- :mod:`fleet` — :class:`FleetRouter`: a host-level router over K
+  replicas — SLO-aware dispatch, replica failure detection with a
+  circuit breaker, journal-backed request migration, and role-tagged
+  prefill/decode replicas with paged-block KV handoff.
 """
 from deepspeed_tpu.serving.engine import InferenceEngine
+from deepspeed_tpu.serving.fleet import (FleetConfig, FleetRouter,
+                                         ReplicaHandle)
 from deepspeed_tpu.serving.kv_cache import PagedKVPool
 from deepspeed_tpu.serving.metrics import CompilationCounter, ServingMetrics
 from deepspeed_tpu.serving.reliability import (ReliabilityConfig,
@@ -23,4 +29,5 @@ from deepspeed_tpu.serving.scheduler import Request, Scheduler
 
 __all__ = ["InferenceEngine", "PagedKVPool", "Scheduler", "Request",
            "ServingMetrics", "CompilationCounter", "ReliabilityConfig",
-           "RequestJournal"]
+           "RequestJournal", "FleetRouter", "FleetConfig",
+           "ReplicaHandle"]

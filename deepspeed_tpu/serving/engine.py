@@ -326,6 +326,10 @@ class InferenceEngine:
         self._rids = itertools.count()
         self._warming = False
         self._drain_requested = False
+        # fleet identity: set by serving/fleet.py's router so per-replica
+        # chaos (kill_replica / slow_replica) can target THIS engine;
+        # None = not part of a fleet, fleet hooks are no-ops
+        self._replica_index = None
         rel_cfg = reliability if isinstance(reliability, ReliabilityConfig) \
             else ReliabilityConfig(**(reliability or {}))
         self.reliability = Reliability(self, rel_cfg)
@@ -418,14 +422,21 @@ class InferenceEngine:
 
     def submit(self, prompt, max_new_tokens, *, priority=0,
                eos_token_id=None, seed=0, deadline_s=None,
-               work_budget=None, _generated=None, _rid=None) -> int:
+               work_budget=None, _generated=None, _rid=None,
+               _work_done=0, _readmit=False) -> int:
         """Submit one request.  ``deadline_s``/``work_budget`` (engine
         defaults from the ReliabilityConfig) bound its wall-clock life
         and total scheduled token-writes; under predicted SLO overload
         the admission gate may shed lower-priority queued work or turn
         this request away (``results[rid]["status"] == "shed"``).
-        ``_generated``/``_rid`` are the :meth:`recover` re-submission
-        hooks (journal replay through the eviction re-prefill path)."""
+        ``_generated``/``_rid``/``_work_done`` are the :meth:`recover`
+        re-submission hooks (journal replay through the eviction
+        re-prefill path; the restored ``_work_done`` keeps work budgets
+        accumulating across crash-migrate cycles instead of granting
+        each recovery a fresh budget).  ``_readmit=True`` marks a
+        recovery/migration re-submission: the request was ADMITTED once
+        already, so the SLO admission gate must not shed it again — it
+        is journaled directly."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         assert prompt.size >= 1 and max_new_tokens >= 1
         total = prompt.size + int(max_new_tokens)
@@ -451,9 +462,17 @@ class InferenceEngine:
             req.deadline = self.clock() + float(deadline_s)
         if _generated:
             req.generated = [int(t) for t in _generated]
+        if _work_done:
+            req.work_done = int(_work_done)
         self.metrics.record_submit(rid)
         if not self._warming:
-            if self.reliability.on_submit(req) == "reject":
+            if _readmit:
+                # already-admitted work (recovery/migration): bypass the
+                # shedding gate, but journal it here so THIS engine's
+                # crash covers it too
+                if self.reliability.journal is not None:
+                    self.reliability.journal.record_submit(req)
+            elif self.reliability.on_submit(req) == "reject":
                 self.results[rid] = {
                     "tokens": np.asarray(req.full_tokens, np.int32),
                     "status": ABORT_SHED, "evictions": 0,
@@ -478,7 +497,9 @@ class InferenceEngine:
         self._step_idx += 1
         tr = self._tracer
         _t0 = tr.begin() if tr is not None else 0.0
-        slow = chaos.serving_slow_step_s(self._step_idx)
+        slow = chaos.serving_slow_step_s(self._step_idx) \
+            + chaos.fleet_slow_replica_s(self._replica_index,
+                                         self._step_idx)
         if slow:
             time.sleep(slow)
         if self._watchdog is not None:
@@ -622,7 +643,8 @@ class InferenceEngine:
                 e["max_new"], priority=e["priority"],
                 eos_token_id=e["eos"], seed=e["seed"],
                 deadline_s=e["deadline_s"], work_budget=e["work_budget"],
-                _generated=e["generated"], _rid=e["rid"])
+                _generated=e["generated"], _rid=e["rid"],
+                _work_done=e.get("work_done", 0), _readmit=True)
             rids.append(rid)
             max_rid = max(max_rid, rid)
         self._rids = itertools.count(max_rid + 1)
@@ -632,6 +654,131 @@ class InferenceEngine:
         logger.info("recover: re-submitted %d journaled requests from %s",
                     len(rids), journal_path)
         return rids
+
+    # -- fleet migration (serving/fleet.py drives these) ----------------
+    def export_request(self, rid) -> dict:
+        """Detach one RUNNING request for migration to another replica:
+        ONE batched device fetch of its paged KV blocks (a fixed-shape
+        (L, W, ...) gather — compiles once, shared by every same-config
+        replica), then scheduler/pool/journal bookkeeping that removes
+        the request WITHOUT a terminal result — its journal end record
+        says ``migrated``, so this replica's journal no longer lists it
+        live (the destination's journal does, from its re-submission).
+        Returns the state dict :meth:`import_request` consumes.
+
+        The KV handoff is the disaggregated prefill/decode transfer of
+        PAPERS.md 2601.02311: prefill is compute-bound, decode is
+        memory-bound, and moving the finished prompt's KV blocks once
+        is what makes separately-provisioned replicas composable.  The
+        payload is priced analytically by
+        ``comm_accounting.serving_kv_handoff_collectives``."""
+        assert self.shards == 1, \
+            "KV handoff exports a host copy of the page view; sharded " \
+            "pools hand off per-shard (not yet wired) — use shards=1 " \
+            "replicas in role-split fleets"
+        req = self.scheduler.requests.get(rid)
+        assert req is not None and req.state is RequestState.RUNNING, \
+            f"export_request({rid}): not a RUNNING request"
+        assert req.generated, "RUNNING request with no first token"
+        row = self.pool.table_row(rid, self.W)
+        n_blocks = len(self.pool._blocks[rid])
+        n_positions = self.pool._positions[rid]
+        # one fixed-shape gather + ONE batched fetch: (L, W, H, bs, D)
+        # per pool tensor, trash-padded rows included (their content is
+        # garbage by contract; the value mask keeps it inert)
+        kv = jax.device_get(tuple(
+            a[:, row] for a in self.pool.tensors.arrays))
+        slot = req.slot
+        self.scheduler.finish(req, "migrated")
+        self.pool.free(rid)
+        self._clear_slot(slot)
+        self.metrics.record_finish(rid, "migrated")
+        if not self._warming:
+            self.reliability.on_finish(req, "migrated")
+        return {
+            "rid": req.rid, "prompt": req.prompt,
+            "generated": list(req.generated),
+            "max_new_tokens": req.max_new_tokens,
+            "priority": req.priority, "eos": req.eos_token_id,
+            "seed": req.seed, "deadline_s": req.deadline_s,
+            "work_budget": req.work_budget, "work_done": req.work_done,
+            "evictions": req.evictions,
+            "kv": kv, "n_blocks": n_blocks, "n_positions": n_positions,
+        }
+
+    def import_request(self, entry) -> str:
+        """Adopt a migrated RUNNING request with its transferred KV:
+        allocate blocks, scatter the paged rows into the local pool (one
+        fixed-shape ``.at[].set`` per pool tensor — compiles once), and
+        join the decode batch DIRECTLY, no re-prefill.  Decoding resumes
+        at the exact position the source stopped, so greedy
+        continuations stay bit-identical.  Falls back to the journal
+        re-prefill path (a normal re-submission) when no slot or not
+        enough blocks are free here — always correct, just re-pays the
+        prefill.  Deadlines restart relative (the :meth:`recover`
+        semantics — clocks do not cross replicas); work budgets carry
+        over.  Returns ``"adopted"`` or ``"requeued"``."""
+        assert self.shards == 1, "see export_request"
+        rid = int(entry["rid"])
+        assert rid not in self.scheduler.requests, \
+            f"import_request({rid}): rid already live here"
+        slot = self.scheduler.free_slot()
+        shard = 0 if slot is None else self._shard_for_slot(slot)
+        if slot is None \
+                or self.pool.free_blocks(shard) < entry["n_blocks"]:
+            self.submit(np.asarray(entry["prompt"], np.int32),
+                        entry["max_new_tokens"],
+                        priority=entry["priority"],
+                        eos_token_id=entry["eos"], seed=entry["seed"],
+                        deadline_s=entry["deadline_s"],
+                        work_budget=entry["work_budget"],
+                        _generated=entry["generated"], _rid=rid,
+                        _work_done=entry["work_done"], _readmit=True)
+            return "requeued"
+        req = Request(rid=rid,
+                      prompt=np.asarray(entry["prompt"], np.int32),
+                      max_new_tokens=int(entry["max_new_tokens"]),
+                      priority=int(entry["priority"]),
+                      eos_token_id=entry["eos"], seed=int(entry["seed"]),
+                      deadline_s=entry["deadline_s"],
+                      work_budget=entry["work_budget"])
+        req.generated = [int(t) for t in entry["generated"]]
+        assert req.generated, "adopted request must carry a first token"
+        req.work_done = int(entry["work_done"])
+        req.evictions = int(entry.get("evictions", 0))
+        req.prefill_done = len(req.full_tokens)
+        req.shard = shard
+        if req.deadline_s is not None:
+            req.deadline = self.clock() + float(req.deadline_s)
+        ok = self.pool.alloc(rid, shard, entry["n_positions"])
+        assert ok, "free_blocks precheck lied"
+        dst_row = self.pool.table_row(rid, self.W)
+        t = self.pool.tensors.arrays
+        self._rebind(tuple(
+            a.at[:, dst_row].set(jnp.asarray(part))
+            for a, part in zip(t, entry["kv"])))
+        self.scheduler.adopt_running(req, slot)
+        self._tables[slot] = dst_row
+        self._pos[slot] = len(req.full_tokens) - 1
+        self._tok[slot] = req.generated[-1]
+        self._seeds[slot] = req.seed
+        self._active[slot] = True
+        # journal directly (no admission gate: this work was admitted
+        # once already); no metrics.record_submit — TTFT stays at the
+        # replica that admitted it
+        if not self._warming and self.reliability.journal is not None:
+            self.reliability.journal.record_submit(req)
+        return "adopted"
+
+    def can_adopt(self, n_blocks) -> bool:
+        """True when :meth:`import_request` would adopt directly (a
+        free slot whose shard has ``n_blocks`` free) — the router
+        checks BEFORE exporting, so a full decode tier never pays a
+        device fetch just to discard the computed KV and re-prefill."""
+        slot = self.scheduler.free_slot()
+        return slot is not None and \
+            self.pool.free_blocks(self._shard_for_slot(slot)) \
+            >= n_blocks
 
     def warmup(self) -> None:
         """Compile every program the steady state can need — the decode
@@ -967,6 +1114,7 @@ class InferenceEngine:
         # kill-mid-decode chaos: the dispatch happened, NO host
         # bookkeeping has — the journal holds the last committed step
         chaos.serving_kill_step(self._step_idx)
+        chaos.fleet_kill_replica_step(self._replica_index, self._step_idx)
         # ONE batched fetch per step: sampled tokens + per-lane
         # finiteness (the poison detector) travel together
         toks, fins = jax.device_get((out[-2], out[-1]))

@@ -192,6 +192,11 @@ class PagedKVPool:
         (the engine steers new sequences toward the least-loaded shard)."""
         return len(self._free[shard])
 
+    def blocks_of(self, rid: int) -> int:
+        """Blocks currently allocated to ``rid`` (0 when unknown) — the
+        payload size a KV handoff of this request would transfer."""
+        return len(self._blocks.get(rid, ()))
+
     # -- accounting -----------------------------------------------------
     @property
     def usable_blocks(self) -> int:

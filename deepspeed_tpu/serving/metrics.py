@@ -89,6 +89,8 @@ class ServingMetrics:
         self.ttft: List[float] = []
         self.completed = 0
         self.cancelled = 0
+        self.migrated = 0              # handed off to another replica
+        self.migrated_tokens = 0       # tokens billed at the destination
         self.evictions = 0
         # reliability-layer abort counters, keyed by abort reason
         # (expired / budget / shed / poisoned)
@@ -128,10 +130,17 @@ class ServingMetrics:
         """Terminal accounting.  Only ``finished`` tokens count toward
         goodput — everything a cancelled/expired/shed/poisoned request
         generated was work the engine cannot bill, and the overload
-        guard needs that honest denominator."""
+        guard needs that honest denominator.  ``migrated`` is neither:
+        the request left ALIVE for another replica, so its tokens are
+        neither useful nor wasted here — they complete (and bill) at
+        the destination."""
         if reason == "finished":
             self.completed += 1
             self.useful_tokens += self._tokens.get(rid, 0)
+            return
+        if reason == "migrated":
+            self.migrated += 1
+            self.migrated_tokens += self._tokens.pop(rid, 0)
             return
         self.wasted_tokens += self._tokens.get(rid, 0)
         if reason == "cancelled":
@@ -163,6 +172,32 @@ class ServingMetrics:
         self._fragmentation.add(fragmentation)
 
     # -- summary --------------------------------------------------------
+    def ttft_of(self, rid):
+        """TTFT of ONE request (None when it has not produced a first
+        token here, or arrived elsewhere — a migrated-in request keeps
+        its TTFT at the replica that admitted it)."""
+        if rid in self._first_token and rid in self._arrival:
+            return self._first_token[rid] - self._arrival[rid]
+        return None
+
+    def export_timing(self, rid):
+        """``(arrival, first_token)`` stamps of a migrating request —
+        in-process fleet replicas share one clock, so the stamps carry
+        across replicas verbatim."""
+        return self._arrival.get(rid), self._first_token.get(rid)
+
+    def adopt_timing(self, rid, arrival_s, first_token_s):
+        """Carry a migrated-in request's original stamps so the fleet
+        counts exactly ONE TTFT sample per rid: restoring the arrival
+        makes the eventual sample include time spent waiting on the
+        dead/drained source, and restoring the first-token stamp (when
+        the source already emitted it) suppresses a duplicate sample
+        here — :meth:`record_token` only samples an unseen rid."""
+        if arrival_s is not None:
+            self._arrival[rid] = arrival_s
+        if first_token_s is not None and rid not in self._first_token:
+            self._first_token[rid] = first_token_s
+
     def step_time(self):
         """EMA of the wall time between consecutive serving steps — the
         admission gate's measured-TPOT proxy (one decode step emits one
@@ -184,6 +219,7 @@ class ServingMetrics:
             "requests": {
                 "completed": self.completed,
                 "cancelled": self.cancelled,
+                "migrated": self.migrated,
                 "evictions": self.evictions,
                 "aborted": dict(self.aborted),
             },
@@ -193,7 +229,8 @@ class ServingMetrics:
             "tpot_s": self.tpot(),
             "tokens": {"generated": self.total_tokens,
                        "useful": self.useful_tokens,
-                       "wasted": self.wasted_tokens},
+                       "wasted": self.wasted_tokens,
+                       "migrated_out": self.migrated_tokens},
             "throughput": {
                 "wall_s": wall,
                 "tokens_per_s": (self.total_tokens / wall) if wall > 0

@@ -71,13 +71,21 @@ class RequestJournal:
     Record kinds::
 
         {"op": "submit", "rid", "prompt", "max_new", "priority",
-         "eos", "seed", "deadline_s", "work_budget", "generated"}
+         "eos", "seed", "deadline_s", "work_budget", "generated",
+         "work_done"}
         {"op": "tok", "rid", "t": [tokens accepted this step]}
         {"op": "end", "rid", "status"}
 
     ``deadline_s`` is the request's RELATIVE budget: wall clocks are not
     comparable across processes (``time.monotonic``), so recovery grants
     a fresh deadline of the same length — documented, honest semantics.
+    ``work_done`` is different: the work BUDGET bounds total scheduled
+    token-writes across the request's whole life, so it must CARRY OVER
+    — the submit record journals the work already charged at submission
+    and :meth:`replay` adds the work provably done since (committed
+    decode steps, plus the prefill that demonstrably ran if any token
+    was committed), so repeated crash-migrate cycles keep accumulating
+    against the bound instead of resetting it.
     Token records are buffered per step and flushed by :meth:`commit`
     (once per serving step), so a crash loses at most the current
     step's tokens and the journal is always record-aligned.
@@ -106,6 +114,9 @@ class RequestJournal:
             "work_budget": req.work_budget,
             # non-empty for recovered requests: the re-prefill baseline
             "generated": [int(t) for t in req.generated],
+            # work already charged at submission (non-zero for
+            # recovered/migrated requests) — budgets carry over
+            "work_done": int(req.work_done),
         })
         # the returned rid is an ACCEPTANCE acknowledgment — the submit
         # record must survive a crash in the same step, so it flushes
@@ -122,6 +133,11 @@ class RequestJournal:
         self._flush_tokens(rid)
         self._live.discard(rid)
         self._write({"op": "end", "rid": rid, "status": status})
+        # an end record changes what replay() migrates — a "migrated"
+        # end left buffered while the host crashes would re-place a
+        # request that already lives on another replica, so end records
+        # flush immediately, same rationale as submit records
+        self._fh.flush()
 
     def commit(self) -> None:
         """Step-boundary durability point: flush every buffered token
@@ -156,7 +172,18 @@ class RequestJournal:
         """Reconstruct the LIVE request set from a journal: submit
         records (in original FCFS order) minus ended ones, each with
         every committed generated token.  Tolerates a torn final line
-        (the crash can land mid-write of the last record)."""
+        (the crash can land mid-write of the last record).
+
+        ``work_done`` restoration (budgets carry over, deadlines do
+        not): the submit record's journaled baseline, plus one work
+        unit per token committed since (each committed token is one
+        scheduled decode write), plus — when any token WAS committed —
+        the prefill token-writes that demonstrably ran to produce it
+        (prompt + the tokens the submit record already carried).  A
+        request that never produced a token keeps its baseline alone.
+        The estimate is deliberately >= the work actually scheduled, so
+        repeated crash-migrate cycles converge ON OR BEFORE the budget
+        bound, never past it."""
         live: Dict[int, dict] = {}
         order: List[int] = []
         with open(path, "r", encoding="utf-8") as fh:
@@ -175,13 +202,45 @@ class RequestJournal:
                 if op == "submit":
                     entry = dict(rec)
                     entry["generated"] = list(rec.get("generated", []))
+                    entry["work_done"] = int(rec.get("work_done", 0))
+                    entry["_committed_toks"] = 0
                     live[rid] = entry
                     order.append(rid)
                 elif op == "tok" and rid in live:
                     live[rid]["generated"].extend(rec["t"])
+                    live[rid]["_committed_toks"] += len(rec["t"])
                 elif op == "end":
                     live.pop(rid, None)
-        return [live[r] for r in order if r in live]
+        out = []
+        for r in order:
+            if r not in live:
+                continue
+            e = live[r]
+            committed = e.pop("_committed_toks")
+            if committed:
+                prefill_paid = len(e.get("prompt", [])) \
+                    + (len(e["generated"]) - committed)
+                e["work_done"] += committed + prefill_paid
+            out.append(e)
+        return out
+
+    @staticmethod
+    def replay_many(paths) -> List[dict]:
+        """Merge the live request sets of SEVERAL journals — the fleet
+        router's whole-fleet recovery path, where each dead replica left
+        its own journal.  Replicas hold DISTINCT rid namespaces (the
+        router assigns globally-unique rids in arrival order), so the
+        global FCFS order across journals IS ascending rid order; each
+        journal individually tolerates its own torn final record.  A rid
+        appearing live in more than one journal (a request migrated
+        mid-flight whose source end record was lost with the crash)
+        resolves to the LATER journal in ``paths`` — the router lists
+        journals in migration order, so the freshest copy wins."""
+        merged: Dict[int, dict] = {}
+        for path in paths:
+            for e in RequestJournal.replay(path):
+                merged[e["rid"]] = e
+        return [merged[r] for r in sorted(merged)]
 
 
 class Reliability:

@@ -241,6 +241,20 @@ class Scheduler:
         req.state = RequestState.RUNNING
         self.running[req.slot] = req
 
+    def adopt_running(self, req: Request, slot: int) -> None:
+        """Adopt a migrated-in request DIRECTLY into the decode batch
+        (its KV arrived as a paged-block transfer — no prefill here).
+        FCFS age restarts in this scheduler's sequence space: the
+        request is older than anything submitted after it arrives,
+        exactly like a normal admission at this instant."""
+        assert slot not in self.running, slot
+        assert req.rid not in self.requests, req.rid
+        req.submit_seq = next(self._seq)
+        req.state = RequestState.RUNNING
+        req.slot = slot
+        self.requests[req.rid] = req
+        self.running[slot] = req
+
     def drop_prefill(self, req: Request, *, requeue: bool) -> None:
         assert req is self.prefilling
         self.prefilling = None

@@ -531,6 +531,65 @@ def test_host_sync_covers_serving_reliability_hot_fns(src, label, path):
     assert rule_names(got) == ["host-sync"], (label, path)
 
 
+HS_FLEET_BAD = """
+class FleetRouter:
+    def step(self):
+        for rep in self.replicas:
+            rep.engine.step()
+            jax.device_get(rep.engine.pool.tensors.k)
+"""
+
+HS_FLEET_MIGRATE_BAD = """
+class FleetRouter:
+    def _migrate(self, rep, events):
+        for e in RequestJournal.replay(rep.journal_path):
+            target = self._place(len(e["prompt"]), exclude=rep)
+            target.engine.submit(e["prompt"], e["max_new"])
+            target.engine.pool.tensors.k.block_until_ready()
+"""
+
+HS_FLEET_GOOD = """
+class FleetRouter:
+    def step(self):
+        events = {"failures": []}
+        for rep in self.replicas:
+            self._step_replica(rep, events)
+        return events
+
+    def _handoff_tick(self, rep, events):
+        req = min(rep.engine.scheduler.running.values(),
+                  key=lambda r: r.submit_seq)
+        entry = rep.engine.export_request(req.rid)
+        target = self._place(0, decode_target=True, exclude=rep)
+        target.engine.import_request(entry)
+
+    def _migrate(self, rep, events):
+        for e in RequestJournal.replay(rep.journal_path):
+            target = self._place(len(e["prompt"]), exclude=rep)
+            target.engine.submit(e["prompt"], e["max_new"])
+"""
+
+
+@pytest.mark.parametrize("src,label", [
+    (HS_FLEET_BAD, "step"),
+    (HS_FLEET_MIGRATE_BAD, "_migrate"),
+])
+def test_host_sync_covers_fleet_router_hot_fns(src, label):
+    """ISSUE 11 satellite: the fleet router's step loop and migration
+    path are hot — a device sync per replica/request there serializes
+    the whole fleet against the host."""
+    got = lint(src, "deepspeed_tpu/serving/fleet.py", rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"], label
+
+
+def test_host_sync_quiet_on_fleet_straight_line_handoff():
+    # per-replica stepping through a helper, a straight-line handoff
+    # (the ONE blessed device touch) and a sync-free migration loop:
+    # no findings
+    assert lint(HS_FLEET_GOOD, "deepspeed_tpu/serving/fleet.py",
+                rules=["host-sync"]) == []
+
+
 def test_host_sync_quiet_on_host_only_reliability_fns():
     # the real implementations are pure host accounting: clock reads,
     # dict walks, journal appends — no findings
@@ -711,6 +770,32 @@ def test_disarmed_discipline_covers_arm_shedding_path():
     assert rule_names(got) == ["disarmed-discipline"]
     assert "_arm_shedding" in got[0].message
     assert lint(DISARM_SHED_GOOD, rules=["disarmed-discipline"]) == []
+
+
+DISARM_DISPATCH_BAD = """
+class FleetRouter:
+    def _arm_dispatch(self):
+        self.dispatch_armed = self.config.dispatch == "slo" and all(
+            r.engine.scheduler.policy == "continuous"
+            for r in self.replicas)
+"""
+
+DISARM_DISPATCH_GOOD = DISARM_DISPATCH_BAD + """
+        if self.config.dispatch == "slo" and not self.dispatch_armed:
+            logger.warning("SLO-aware dispatch DISARMED - a replica "
+                           "policy the TTFT model cannot describe; "
+                           "falling back to round-robin")
+"""
+
+
+def test_disarmed_discipline_covers_arm_dispatch_path():
+    """ISSUE 11 satellite: the fleet router's placement arming fn is
+    held to the armed-or-warns discipline — a silent round-robin
+    fallback fires; warning DISARMED quiets it."""
+    got = lint(DISARM_DISPATCH_BAD, rules=["disarmed-discipline"])
+    assert rule_names(got) == ["disarmed-discipline"]
+    assert "_arm_dispatch" in got[0].message
+    assert lint(DISARM_DISPATCH_GOOD, rules=["disarmed-discipline"]) == []
 
 
 # ---------------------------------------------------------------------------

@@ -437,6 +437,7 @@ def test_journal_replay_units(tmp_path):
             self.deadline_s = 2.5
             self.work_budget = 99
             self.generated = list(generated)
+            self.work_done = 0
 
     path = str(tmp_path / "j.jsonl")
     j = RequestJournal(path)

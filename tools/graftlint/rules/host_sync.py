@@ -44,6 +44,7 @@ HOT_FILES = {
     "deepspeed_tpu/serving/scheduler.py",
     "deepspeed_tpu/serving/kv_cache.py",
     "deepspeed_tpu/serving/reliability.py",
+    "deepspeed_tpu/serving/fleet.py",
 }
 HOT_FN_RE = re.compile(
     r"^(train_batch|eval_batch|forward|backward|step"
@@ -55,7 +56,15 @@ HOT_FN_RE = re.compile(
     # hooks and drain/recover all run at step boundaries — a device
     # sync per live request there serializes the whole batch
     r"|_enforce_deadlines|_abort|recover|drain|request_drain"
-    r"|on_\w+|record_\w+|commit|replay|predicted_\w+)$")
+    r"|on_\w+|record_\w+|commit|replay|predicted_\w+"
+    # fleet router (ISSUE 11): the router step loop, placement and
+    # migration/handoff paths run once per fleet step over every
+    # replica — a device sync per replica/request there serializes
+    # the whole fleet (the single batched handoff fetch is the ONLY
+    # blessed device touch, straight-line in _handoff_tick)
+    r"|_step_replica|_place|_eligible|_migrate\w*|_handoff_tick"
+    r"|_on_failure|_mark_dead|_retire_drained|drain_replica"
+    r"|has_work|export_request|import_request|adopt_running)$")
 # benchmark drivers: every loop is (or brackets) a timed region — a sync
 # per iteration pollutes the measured step time with transfer latency
 BENCH_FILES = {"bench.py", "tools/pipe_bench.py", "tools/serve_bench.py"}

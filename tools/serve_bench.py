@@ -20,6 +20,14 @@ Traffic modes (``--traffic``):
 - ``shared-prefix`` — every prompt shares a long system-prompt prefix
   (ROADMAP item 3's workload; today it prices the duplicated prefill
   that a future prefix cache removes).
+- ``replica-failure`` — the fleet A/B (``--fleet K`` replicas behind
+  the SLO-aware router, ISSUE 11): the SAME traffic twice on a step
+  clock, once undisturbed and once with chaos hard-killing 1 of K
+  replicas mid-run (``--kill-step``).  The router's circuit breaker
+  marks it dead and migrates its journal-live requests onto survivors;
+  the guard is that EVERY request still completes (zero lost) and the
+  reported p95-TTFT / goodput ratios are the measured price of losing
+  1/K of the fleet.
 
 Two throughput views everywhere:
 
@@ -316,11 +324,110 @@ def run_shared_prefix(model, params, args, out):
     return 0
 
 
+def run_replica_failure(model, params, args, out):
+    """Fleet resilience A/B: K replicas, same traffic, with and without
+    a mid-run hard kill of replica 1.  Latencies on the step clock."""
+    import tempfile
+    import time as time_mod
+
+    from deepspeed_tpu.runtime.resilience import chaos
+    from deepspeed_tpu.serving.fleet import FleetRouter
+
+    workload = make_workload(args.requests, args.vocab, args.seed)
+    # 2 arrivals/step: a K=3 fleet is admission-bound at ~3/step, so
+    # the whole fleet carries live work when the kill lands — the
+    # failure leg actually exercises migration, not an idle corpse
+    arrivals = [i // 2 for i in range(len(workload))]
+
+    def drive(kill_step):
+        clock = StepClock()
+        jd = tempfile.mkdtemp(prefix="serve_bench_fleet_")
+        router = FleetRouter(
+            model, params, replicas=args.fleet, clock=clock,
+            journal_dir=jd,
+            config={"max_consecutive_failures": 2,
+                    "retry_backoff_steps": 1},
+            engine_kwargs=dict(max_slots=args.slots, kv_block_size=16,
+                               prefill_chunk=args.chunk,
+                               max_blocks_per_seq=8))
+        router.warmup()
+        if kill_step:
+            chaos.arm(kill_replica_after_steps=kill_step,
+                      kill_replica=1)
+        t0 = time_mod.perf_counter()
+        rids = []
+        try:
+            pending = [(arrivals[i], w) for i, w in enumerate(workload)]
+            steps = 0
+            while pending or router.has_work():
+                while pending and pending[0][0] <= steps:
+                    _, (prompt, max_new) = pending.pop(0)
+                    rids.append(router.submit(prompt,
+                                              max_new_tokens=max_new))
+                router.step()
+                clock.t += 1.0
+                steps += 1
+                assert steps < 5000, "fleet bench did not converge"
+        finally:
+            chaos.disarm()
+        wall = time_mod.perf_counter() - t0
+        rep = router.fleet_report()
+        res = router.results
+        finished = sum(1 for rid in rids
+                       if res.get(rid, {}).get("status") == "finished")
+        return {
+            "submitted": len(rids), "completed": finished,
+            "steps": steps, "wall_s": _r(wall),
+            "replica_states": {k: v["state"]
+                               for k, v in rep["replicas"].items()},
+            "placements": rep["router"]["placements"],
+            "migrations": rep["router"]["migrations"],
+            "lost": rep["router"]["lost"],
+            "ttft_mean": _r(rep["router"]["ttft_s"]["mean"]),
+            "ttft_p95": _r(rep["router"]["ttft_s"]["p95"]),
+            "goodput_tokens_per_slot_step":
+                _r(rep["router"]["goodput_tokens_per_slot_step"]),
+            "dispatch_armed": rep["config"]["dispatch_armed"],
+        }
+
+    baseline = drive(0)
+    failure = drive(args.kill_step)
+    out.update({
+        "baseline": baseline, "failure": failure,
+        "kill": {"replica": 1, "of": args.fleet,
+                 "after_steps": args.kill_step},
+        "latency_unit": "serving steps (step clock)",
+    })
+    out["ttft_p95_ratio"] = _r(
+        failure["ttft_p95"] / baseline["ttft_p95"], 3) \
+        if baseline["ttft_p95"] else None
+    out["goodput_ratio"] = _r(
+        failure["goodput_tokens_per_slot_step"]
+        / baseline["goodput_tokens_per_slot_step"], 3) \
+        if baseline["goodput_tokens_per_slot_step"] else None
+    for tag, row in (("baseline", baseline), ("failure", failure)):
+        print(f"{tag:>18}: {row['completed']}/{row['submitted']} done "
+              f"in {row['steps']} steps | TTFT mean {row['ttft_mean']} "
+              f"p95 {row['ttft_p95']} | goodput "
+              f"{row['goodput_tokens_per_slot_step']} | migrations "
+              f"{row['migrations']} lost {len(row['lost'])}")
+    ok = (failure["completed"] == failure["submitted"]
+          and not failure["lost"] and failure["migrations"] > 0
+          and failure["replica_states"]["replica1"] == "dead")
+    out["guard_ok"] = ok
+    print(f"replica-failure guard: {'OK' if ok else 'FAIL'} — killing "
+          f"1 of {args.fleet} mid-run lost ZERO requests "
+          f"({failure['migrations']} migrated); p95 TTFT "
+          f"{out['ttft_p95_ratio']}x, goodput {out['goodput_ratio']}x "
+          f"vs the no-failure baseline")
+    return 0 if ok else 1
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--traffic", default="steady",
                    choices=["steady", "bursty", "overload",
-                            "shared-prefix"])
+                            "shared-prefix", "replica-failure"])
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--requests", type=int, default=32)
     p.add_argument("--chunk", type=int, default=16)
@@ -341,6 +448,11 @@ def main(argv=None):
                    help="TTFT SLO in steps (overload)")
     p.add_argument("--deadline-steps", type=float, default=24.0,
                    help="per-request deadline in steps (overload)")
+    p.add_argument("--fleet", type=int, default=3,
+                   help="replicas behind the router (replica-failure)")
+    p.add_argument("--kill-step", type=int, default=12,
+                   help="engine step at which chaos hard-kills replica "
+                        "1 (replica-failure)")
     p.add_argument("--json", default=None)
     args = p.parse_args(argv)
 
@@ -350,7 +462,8 @@ def main(argv=None):
                       "chunk": args.chunk, "seed": args.seed}}
     rc = {"steady": run_steady, "bursty": run_bursty,
           "overload": run_overload,
-          "shared-prefix": run_shared_prefix}[args.traffic](
+          "shared-prefix": run_shared_prefix,
+          "replica-failure": run_replica_failure}[args.traffic](
         model, params, args, out)
     if args.json:
         with open(args.json, "w") as f:

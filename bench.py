@@ -47,7 +47,7 @@ REFERENCE_TFLOPS_PER_CHIP = 64.0
 # spec keys that define a bench configuration (the phase-cache identity)
 _SPEC_KEYS = ("model", "batch", "seq", "steps", "warmup", "scan_layers",
               "remat", "remat_policy", "allow_cpu", "loss_chunk", "offload",
-              "onebit", "sparse", "zero_stage")
+              "onebit", "sparse", "zero_stage", "chaos")
 
 
 def _cfg_hash(spec, base=None):
@@ -221,6 +221,9 @@ def _run_one(args, ctx) -> int:
     if args.onebit:
         return run_onebit_worker(args, jax, jnp, np, device_kind, platform,
                                  n_dev)
+    if getattr(args, "chaos", ""):
+        return run_chaos_worker(args, jax, jnp, np, device_kind, platform,
+                                n_dev)
     if args.zero_stage == 3:
         return run_stage3_worker(args, jax, jnp, np, device_kind, platform,
                                  n_dev)
@@ -519,6 +522,129 @@ def run_stage3_worker(args, jax, jnp, np, device_kind, platform, n_dev):
     return 0
 
 
+def run_chaos_worker(args, jax, jnp, np, device_kind, platform, n_dev):
+    """ISSUE 12 failure-injection rung (``--chaos rank-kill``): a
+    SUPERVISED training run where one simulated host hard-dies mid-run.
+    The TrainingSupervisor must reach a coordinated dead verdict within
+    the heartbeat window and elastically restart on the survivors; the
+    published numbers are the recovery economics — goodput samples per
+    WALL step (blocked/recovery ticks in the denominator) and MTTR in
+    steps — both step-denominated so the rung is clock-honest on any
+    backend.  Rounds without chaos simply lack these keys and
+    tools/perf_trend.py shows them as gaps, same as dead rounds."""
+    import shutil
+    import tempfile
+    import time as _t
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Model, gpt2_config
+    from deepspeed_tpu.runtime.resilience import chaos
+    from deepspeed_tpu.runtime.resilience.supervisor import \
+        TrainingSupervisor
+
+    if args.chaos != "rank-kill":
+        print(f"FATAL: unknown --chaos mode {args.chaos!r}",
+              file=sys.stderr, flush=True)
+        return 3
+    if n_dev < 2:
+        print("FATAL: --chaos rank-kill needs >= 2 devices — the elastic "
+              "restart must have a smaller surviving world to land on",
+              file=sys.stderr, flush=True)
+        return 3
+
+    model_name = args.model if args.model.startswith("gpt2") else "gpt2-125m"
+    cfg = gpt2_config(model_name, n_positions=args.seq, dtype=jnp.bfloat16,
+                      remat=bool(args.remat), remat_policy=args.remat_policy,
+                      scan_layers=bool(args.scan_layers),
+                      loss_chunk_tokens=args.loss_chunk)
+    # one fixed dataset, sliced per world: the SAMPLE stream is identical
+    # whatever the mesh, so fast_forward lands on the exact committed
+    # offset after the restart (zero samples lost or replayed)
+    total = args.batch * n_dev * (args.steps + 8)
+    rng = np.random.default_rng(0)
+    data_ids = rng.integers(0, cfg.vocab_size, (total, args.seq))
+
+    def engine_factory(world):
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2Model(cfg), config_params={
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 2},
+                "mesh": {"data": world, "allow_partial": True},
+                "elasticity": {"enabled": True,
+                               "max_train_batch_size": args.batch * n_dev,
+                               "micro_batch_sizes": [args.batch],
+                               "min_gpus": 1, "max_gpus": n_dev,
+                               "version": 0.1},
+                "steps_per_print": 10 ** 9})
+        return engine
+
+    def data_factory(engine):
+        rows = engine.train_micro_batch_size_per_gpu() \
+            * engine.dp_world_size
+
+        def gen():
+            i = 0
+            while True:
+                start = (i * rows) % total
+                sl = data_ids[start:start + rows]
+                if len(sl) < rows:
+                    i = 0
+                    continue
+                yield {"input_ids": sl, "labels": sl.copy()}
+                i += 1
+
+        return gen()
+
+    save_dir = tempfile.mkdtemp(prefix="bench_chaos_")
+    try:
+        sup = TrainingSupervisor(
+            engine_factory, data_factory, save_dir=save_dir,
+            world_size=n_dev,
+            config={"heartbeat_timeout_steps": 2,
+                    "checkpoint_every_steps": 2})
+        kill_at = max(3, args.steps // 2)
+        chaos.arm(kill_ranks=((n_dev - 1, kill_at),))
+        t0 = _t.time()
+        sup.run(args.steps)
+        wall_s = _t.time() - t0
+        chaos.disarm()
+        rep = sup.report()
+    finally:
+        chaos.disarm()
+        shutil.rmtree(save_dir, ignore_errors=True)
+    _phase(f"chaos_recovered:world{sup.world}")
+    if not rep["armed"] or rep["restarts"] < 1:
+        # the rung exists to price recovery; a run that never recovered
+        # (supervision disarmed, kill never fired) must not publish a
+        # flawless goodput number
+        print(f"FATAL: chaos rung ran without a recovery "
+              f"(armed={rep['armed']}, restarts={rep['restarts']}) — "
+              f"refusing to publish", file=sys.stderr, flush=True)
+        return 3
+    print(json.dumps({
+        "metric": f"self-healing training, 1 of {n_dev} hosts killed "
+                  f"mid-run ({model_name} seq{args.seq})",
+        "value": round(rep["goodput_samples_per_wall_step"], 3),
+        "unit": "goodput samples/wall-step",
+        "goodput_samples_per_wall_step":
+            round(rep["goodput_samples_per_wall_step"], 3),
+        "mttr_steps": rep["mttr_steps"],
+        "downtime_wall_steps": rep["downtime_wall_steps"],
+        "restarts": rep["restarts"],
+        "rollbacks": rep["rollbacks"],
+        "world_from": n_dev, "world_to": sup.world,
+        "committed_steps": rep["committed_steps"],
+        "committed_samples": rep["committed_samples"],
+        "wall_steps": rep["wall_steps"],
+        "supervisor_armed": rep["armed"],
+        "wall_s": round(wall_s, 1),
+        "device_kind": device_kind, "platform": platform,
+        "n_devices": n_dev, "batch_per_chip": args.batch,
+    }), flush=True)
+    return 0
+
+
 def run_onebit_worker(args, jax, jnp, np, device_kind, platform, n_dev):
     """BASELINE config 5 (1-bit Adam, reference onebit-adam-blog-post.md:
     85-135): warmup (dense Adam) vs post-freeze (compressed momentum) step
@@ -712,6 +838,41 @@ def _phase_timings(phases, elapsed_s):
     return out
 
 
+def _run_chaos_rung(worker, args, payload, record):
+    """Dispatch the ISSUE-12 failure-injection rung on the warm worker
+    and merge its recovery economics into a successful round's payload:
+    ``goodput_samples_per_wall_step`` + ``mttr_steps`` become top-level
+    keys (tools/perf_trend.py trends them; rounds where this rung fails
+    carry a ``chaos: {error}`` stanza instead — an honest gap)."""
+    # every worker-selection key is PINNED: the rung must reach
+    # run_chaos_worker whatever the base round measured (an inherited
+    # onebit/sparse/offload flag would dispatch a different worker and
+    # record ITS output as a bogus chaos success)
+    chaos_spec = {"model": "gpt2-125m", "batch": 4, "seq": 256,
+                  "steps": 12, "remat": 0, "chaos": "rank-kill",
+                  "onebit": 0, "sparse": 0, "offload": 0, "zero_stage": 2,
+                  "timeout": 300}
+    ckey = _cfg_hash(chaos_spec, args)
+    try:
+        rc, stdout, _err, phases, timed_out = worker.run(
+            chaos_spec, args, chaos_spec["timeout"])
+        if rc == 0 and stdout.strip():
+            cp = json.loads(stdout.strip().splitlines()[-1])
+            payload["chaos"] = cp
+            for k in ("goodput_samples_per_wall_step", "mttr_steps"):
+                payload[k] = cp.get(k)
+            record(ckey, ok=True, value=cp.get("value"),
+                   last_phase=phases[-1][0] if phases else "dispatch")
+        else:
+            payload["chaos"] = {"error": f"chaos rung rc={rc} "
+                                         f"timed_out={timed_out}"}
+            record(ckey, ok=False, timed_out=timed_out,
+                   last_phase=phases[-1][0] if phases else "dispatch")
+    except Exception as e:  # lint: allow-broad-except — the recovery
+        # rung must never eat the round's headline number
+        payload["chaos"] = {"error": str(e)}
+
+
 def run_parent(args) -> int:
     # attempt ladder: requested config first (round-4 tuned: batch 48 +
     # chunked LM head reached 60.2 TFLOPS/chip, 0.94 vs baseline, on a
@@ -859,6 +1020,15 @@ def run_parent(args) -> int:
                         _record(ckey, ok=True, last_phase=last_phase,
                                 elapsed_s=elapsed,
                                 value=payload.get("value"))
+                        # ISSUE 12: recovery economics ride EVERY healthy
+                        # round — the failure-injection rung is not a
+                        # fallback (a goodput number is no substitute for
+                        # a TFLOPS number), it runs AFTER the headline
+                        # metric lands and merges its goodput/MTTR keys
+                        # into the payload; a chaos failure must never
+                        # eat the round's number
+                        if not spec.get("chaos") and not args.single_attempt:
+                            _run_chaos_rung(worker, args, payload, _record)
                         # perf trajectory (ISSUE 10): trend this payload
                         # against prior BENCH_*.json rounds so every
                         # round reports where it stands; a regression is
@@ -976,6 +1146,11 @@ def main():
                    help="ZeRO stage for the training bench; 3 runs the "
                         "scheduled-vs-implicit gather A/B "
                         "(run_stage3_worker)")
+    p.add_argument("--chaos", default="", choices=["", "rank-kill"],
+                   help="failure-injection rung (run_chaos_worker): "
+                        "'rank-kill' hard-kills one simulated host "
+                        "mid-run under TrainingSupervisor and records "
+                        "goodput samples/wall-step + MTTR steps")
     p.add_argument("--onebit", type=int, default=0,
                    help="BASELINE config 5: OneBitAdam wire path, warmup vs "
                         "post-freeze step time")

@@ -311,6 +311,49 @@ class DeepSpeedResilienceConfig:
                 f'"continue", got {self.watchdog_action!r}')
         self.watchdog_emergency_dir = wd.get(WATCHDOG_EMERGENCY_DIR,
                                              WATCHDOG_EMERGENCY_DIR_DEFAULT)
+        sup = d.get(RESILIENCE_SUPERVISOR, {})
+        self.supervisor_heartbeat_timeout_steps = int(
+            sup.get(SUPERVISOR_HEARTBEAT_TIMEOUT,
+                    SUPERVISOR_HEARTBEAT_TIMEOUT_DEFAULT))
+        self.supervisor_max_transient_retries = int(
+            sup.get(SUPERVISOR_MAX_TRANSIENT_RETRIES,
+                    SUPERVISOR_MAX_TRANSIENT_RETRIES_DEFAULT))
+        self.supervisor_retry_backoff_steps = int(
+            sup.get(SUPERVISOR_RETRY_BACKOFF,
+                    SUPERVISOR_RETRY_BACKOFF_DEFAULT))
+        self.supervisor_max_recovery_attempts = int(
+            sup.get(SUPERVISOR_MAX_RECOVERY_ATTEMPTS,
+                    SUPERVISOR_MAX_RECOVERY_ATTEMPTS_DEFAULT))
+        self.supervisor_max_restarts = int(
+            sup.get(SUPERVISOR_MAX_RESTARTS, SUPERVISOR_MAX_RESTARTS_DEFAULT))
+        self.supervisor_checkpoint_every_steps = int(
+            sup.get(SUPERVISOR_CHECKPOINT_EVERY,
+                    SUPERVISOR_CHECKPOINT_EVERY_DEFAULT))
+        if self.supervisor_heartbeat_timeout_steps < 1:
+            raise ValueError(
+                f"resilience.supervisor.{SUPERVISOR_HEARTBEAT_TIMEOUT} must "
+                f"be >= 1 step (a zero window would declare every peer dead "
+                f"on its first in-flight step), got "
+                f"{self.supervisor_heartbeat_timeout_steps}")
+        for label, val in (
+                (SUPERVISOR_MAX_TRANSIENT_RETRIES,
+                 self.supervisor_max_transient_retries),
+                (SUPERVISOR_RETRY_BACKOFF,
+                 self.supervisor_retry_backoff_steps),
+                (SUPERVISOR_CHECKPOINT_EVERY,
+                 self.supervisor_checkpoint_every_steps)):
+            if val < 0:
+                raise ValueError(
+                    f"resilience.supervisor.{label} must be >= 0, got {val}")
+        for label, val in (
+                (SUPERVISOR_MAX_RECOVERY_ATTEMPTS,
+                 self.supervisor_max_recovery_attempts),
+                (SUPERVISOR_MAX_RESTARTS, self.supervisor_max_restarts)):
+            if val < 1:
+                raise ValueError(
+                    f"resilience.supervisor.{label} must be >= 1 (the "
+                    f"supervisor needs at least one recovery attempt to "
+                    f"recover at all), got {val}")
 
 
 def get_resilience_config(param_dict):

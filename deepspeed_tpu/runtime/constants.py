@@ -209,6 +209,25 @@ WATCHDOG_ACTION_DEFAULT = "abort"
 WATCHDOG_EMERGENCY_DIR = "emergency_checkpoint_dir"  # None = last save_dir
 WATCHDOG_EMERGENCY_DIR_DEFAULT = None
 
+# resilience.supervisor sub-block: the self-healing training loop
+# (runtime/resilience/supervisor.py) — failure detection windows and the
+# bounded retry/backoff ladder.  All step-denominated (the supervisor
+# runs on a step clock, so tests and benches are deterministic).
+RESILIENCE_SUPERVISOR = "supervisor"
+SUPERVISOR_HEARTBEAT_TIMEOUT = "heartbeat_timeout_steps"  # silence > N = dead
+SUPERVISOR_HEARTBEAT_TIMEOUT_DEFAULT = 3
+SUPERVISOR_MAX_TRANSIENT_RETRIES = "max_transient_retries"  # in-place retries
+SUPERVISOR_MAX_TRANSIENT_RETRIES_DEFAULT = 2
+SUPERVISOR_RETRY_BACKOFF = "retry_backoff_steps"  # backoff = this *
+# (strike - 1): the FIRST retry is immediate, later strikes wait longer
+SUPERVISOR_RETRY_BACKOFF_DEFAULT = 1
+SUPERVISOR_MAX_RECOVERY_ATTEMPTS = "max_recovery_attempts"  # per incident
+SUPERVISOR_MAX_RECOVERY_ATTEMPTS_DEFAULT = 3
+SUPERVISOR_MAX_RESTARTS = "max_restarts"            # lifetime elastic restarts
+SUPERVISOR_MAX_RESTARTS_DEFAULT = 4
+SUPERVISOR_CHECKPOINT_EVERY = "checkpoint_every_steps"  # commit cadence; 0=off
+SUPERVISOR_CHECKPOINT_EVERY_DEFAULT = 1
+
 #############################################
 # Telemetry (TPU extension): structured step tracing, unified metrics
 # stream, measured-vs-analytic MFU accounting (deepspeed_tpu/telemetry/)

@@ -167,6 +167,10 @@ class DeepSpeedEngine:
         # raise happen at the next optimizer-step boundary
         self._preempt_requested = False
         self._preempt_poll_enabled = False
+        # self-healing supervision (runtime/resilience/supervisor.py):
+        # None until a TrainingSupervisor arms its hook points via
+        # _arm_supervisor — one is-None check per step boundary
+        self._supervisor = None
         self._watchdog = None
         if res.watchdog_enabled:
             from deepspeed_tpu.runtime.resilience.watchdog import \
@@ -1095,6 +1099,10 @@ class DeepSpeedEngine:
         }
         if self.state is not None:
             report["comm"] = self.comm_volume_report()
+        if self._supervisor is not None:
+            # recovery accounting (ISSUE 12): incident ledger, MTTR,
+            # downtime spans, goodput-samples-per-wall-step
+            report["recovery"] = self._supervisor.report()
         tel = self._telemetry
         if tel is None:
             return report
@@ -2867,6 +2875,11 @@ class DeepSpeedEngine:
             metrics["ckpt_commit_pending"] = \
                 int(self._pending_commit is not None)
             self._last_metrics = metrics
+        if self._supervisor is not None:
+            # supervised-step hook point: restart-count/backoff ladder
+            # state rides _last_metrics (and, below, the telemetry step
+            # stream) — pure host dict work, nothing on the device path
+            self._supervisor.on_engine_step(self)
         if self._telemetry is not None:
             # step-aligned telemetry boundary: step_time histogram + one
             # JSONL record of this step's metrics (journal idiom — flush
@@ -2888,6 +2901,52 @@ class DeepSpeedEngine:
         self._maybe_preempt()
 
     # ------------------------------------------------------------------
+    # self-healing supervision (runtime/resilience/supervisor.py, ISSUE 12)
+    # ------------------------------------------------------------------
+    def _arm_supervisor(self, supervisor):
+        """Arm the supervised-step hook points for a TrainingSupervisor,
+        or warn DISARMED naming every blocker.  Armed supervision is
+        purely host-side observation at step boundaries — the compiled
+        device programs are untouched (bit-identical steps, zero extra
+        compiles; pinned by tier-1 tests).  Blockers are the things the
+        recovery ladder cannot work without: a committed-tag directory
+        and the atomic commit discipline (a torn tag is not a rollback
+        target).  A missing elasticity config disarms only the
+        elastic-restart rung — retry and rollback stay armed — but
+        warns, because lost capacity then aborts instead of resharding."""
+        self._supervisor = None
+        blockers = []
+        if not getattr(supervisor, "save_dir", None):
+            blockers.append(
+                "no save_dir — rollback and elastic restart need a "
+                "committed-tag directory")
+        if not self._resilience.atomic_checkpoints:
+            blockers.append(
+                "resilience.atomic_checkpoints is disabled — a torn tag "
+                "could become the rollback target")
+        if blockers:
+            log_dist(
+                f"self-healing supervision DISARMED — "
+                f"{'; '.join(blockers)}; steps run unsupervised (no "
+                f"retry, rollback or elastic restart)",
+                ranks=[0], level=logging.WARNING)
+            return False
+        from deepspeed_tpu.elasticity import elasticity_enabled
+
+        if not elasticity_enabled(self._config._param_dict):
+            log_dist(
+                "supervisor elastic restart DISARMED — no elasticity "
+                "config, so a lost host cannot reshard onto the "
+                "survivors (compute_elastic_config has no valid world "
+                "set) and lost capacity aborts the run; transient retry "
+                "and coordinated rollback stay armed",
+                ranks=[0], level=logging.WARNING)
+        self._supervisor = supervisor
+        log_dist("self-healing supervision armed: heartbeat detection + "
+                 "retry/rollback/elastic-restart ladder", ranks=[0])
+        return True
+
+    # ------------------------------------------------------------------
     # graceful preemption (topology-elastic restart, ISSUE 7)
     # ------------------------------------------------------------------
     def request_preemption(self):
@@ -2905,13 +2964,17 @@ class DeepSpeedEngine:
         :meth:`request_preemption`.  Call it on EVERY process of a
         multi-host run — the per-step preemption poll is a collective
         (coordination.any_flag), so a host that never armed it would
-        leave peers waiting in the agreement.  Main thread only (a
-        Python signal-handler constraint)."""
+        leave peers waiting in the agreement.  Any previously installed
+        Python-level handler is CHAINED, not replaced — a process that
+        also hosts a serving engine (or any client SIGTERM hook) keeps
+        every handler (``signal.signal`` alone is last-wins).  Main
+        thread only (a Python signal-handler constraint)."""
         import signal as signal_mod
 
-        sigs = tuple(signals) if signals else (signal_mod.SIGTERM,)
-        for s in sigs:
-            signal_mod.signal(s, lambda *_a: self.request_preemption())
+        from deepspeed_tpu.runtime.resilience.watchdog import \
+            chain_signal_handlers
+
+        sigs = chain_signal_handlers(self.request_preemption, signals)
         self._preempt_poll_enabled = True
         log_dist(f"preemption handler installed for "
                  f"{[signal_mod.Signals(s).name for s in sigs]}", ranks=[0])

@@ -17,6 +17,10 @@ collective.  This package makes those survivable:
   ``load_checkpoint(elastic=True)`` reshards it onto ANY mesh (new zero
   axis, remapped pipeline chunks, schedule downgrades DISARM-warned),
   with ``compute_elastic_config`` preserving the global batch.
+- ``supervisor``: the self-healing loop that wires the above together —
+  step-clock heartbeat failure detection, coordinated dead verdicts,
+  and a bounded retry / rollback / elastic-restart ladder with MTTR
+  and goodput accounting.
 """
 from deepspeed_tpu.runtime.resilience.atomic import (MANIFEST_NAME,
                                                      CheckpointCorrupt,
@@ -30,10 +34,15 @@ from deepspeed_tpu.runtime.resilience.atomic import (MANIFEST_NAME,
                                                      select_resume_tag,
                                                      verify_tag, write_latest,
                                                      write_manifest)
+from deepspeed_tpu.runtime.resilience.supervisor import (SupervisorConfig,
+                                                         SupervisorGaveUp,
+                                                         TrainingSupervisor,
+                                                         TransientStepFault)
 from deepspeed_tpu.runtime.resilience.watchdog import (GracefulPreemption,
                                                        TrainingWatchdog,
                                                        WatchdogAlarm,
-                                                       WatchdogEvent)
+                                                       WatchdogEvent,
+                                                       chain_signal_handlers)
 
 __all__ = [
     "MANIFEST_NAME", "CheckpointCorrupt", "atomic_tag", "gc_tags",
@@ -41,5 +50,7 @@ __all__ = [
     "read_latest", "read_topology", "resume_candidates",
     "select_resume_tag", "verify_tag", "write_latest", "write_manifest",
     "GracefulPreemption", "TrainingWatchdog", "WatchdogAlarm",
-    "WatchdogEvent",
+    "WatchdogEvent", "chain_signal_handlers",
+    "SupervisorConfig", "SupervisorGaveUp", "TrainingSupervisor",
+    "TransientStepFault",
 ]

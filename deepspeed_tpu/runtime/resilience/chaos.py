@@ -30,9 +30,18 @@ class ChaosPlan:
                  poison_logits_at_step=0, burst_arrival_every=0,
                  burst_arrival_count=0, kill_replica_after_steps=0,
                  kill_replica=0, slow_replica_step_every=0,
-                 slow_replica=0, slow_replica_step_s=0.05):
+                 slow_replica=0, slow_replica_step_s=0.05,
+                 kill_ranks=(), fail_step_transient=0,
+                 fail_step_transient_count=1, silence_heartbeat=None,
+                 kill_once_at_point=None):
         self.kill_after_files = kill_after_files
         self.kill_at_point = kill_at_point
+        self.kill_once_at_point = kill_once_at_point
+        self.kill_ranks = tuple(tuple(p) for p in (kill_ranks or ()))
+        self.fail_step_transient = fail_step_transient
+        self.fail_step_transient_count = fail_step_transient_count
+        self.silence_heartbeat = tuple(silence_heartbeat) \
+            if silence_heartbeat else None
         self.corrupt_after_files = corrupt_after_files
         self.corrupt_nbytes = corrupt_nbytes
         self.nan_grad_steps = nan_grad_steps
@@ -102,6 +111,32 @@ def arm(**kwargs):
                          replica R only (one wedged host in an otherwise
                          healthy fleet; feeds that replica's stall
                          detector without touching its peers).
+    kill_ranks=((R, N), ...)  hard-down simulated TRAINING host R at
+                         supervisor wall step N: it stops heartbeating
+                         and stays down forever (a dead host fails every
+                         retry — the supervisor's circuit breaker must
+                         reach a coordinated dead verdict and restart
+                         elastically on the survivors).  Multiple pairs
+                         model chained failures (a second rank dying
+                         during recovery from the first).
+    fail_step_transient=N, fail_step_transient_count=K  raise a
+                         TRANSIENT fault in the supervised step from
+                         wall step N, for K consecutive attempts
+                         (K=1: the first in-place retry succeeds —
+                         no rollback; K > max_transient_retries:
+                         the retry ladder exhausts and escalates to a
+                         coordinated rollback).
+    silence_heartbeat=(R, N, W)  simulated host R stops heartbeating for
+                         W wall steps starting at step N WITHOUT dying —
+                         a network partition / GC pause; shorter than
+                         the heartbeat window it is honest downtime,
+                         longer and the supervisor correctly declares
+                         the unreachable host dead.
+    kill_once_at_point=NAME  like kill_at_point but fires exactly once —
+                         for killing a RECOVERY mid-flight (e.g.
+                         'before_rollback_load' / 'before_restart_load')
+                         while letting the supervisor's bounded retry
+                         of that recovery then succeed.
     """
     global _plan
     _plan = ChaosPlan(**kwargs)
@@ -173,11 +208,65 @@ def _notify(kind, detail=None):
 
 
 def point(name):
-    """Called by the atomic writer at named commit points."""
+    """Called by the atomic writer (and the supervisor's recovery paths)
+    at named commit points."""
     _notify(f"point_{name}")
+    if _plan is not None and _plan.kill_once_at_point == name:
+        _plan.kill_once_at_point = None     # one-shot: the retry survives
+        _plan.fired.append(("kill_once_at_point", name))
+        raise ChaosInterrupt(f"chaos: one-shot kill at {name!r}")
     if _plan is not None and _plan.kill_at_point == name:
         _plan.fired.append(("kill_at_point", name))
         raise ChaosInterrupt(f"chaos: killed checkpoint commit at {name!r}")
+
+
+def rank_dead(rank, step_index):
+    """True when an armed ``kill_ranks`` plan has simulated host ``rank``
+    hard-down at supervisor wall step ``step_index``.  Monotone: once a
+    host's kill step passes it is dead on every later query (a downed
+    host fails every retry — that is what distinguishes lost capacity
+    from a transient fault)."""
+    if _plan is None or not _plan.kill_ranks:
+        return False
+    for r, s in _plan.kill_ranks:
+        if r == rank and step_index >= s:
+            with _plan._lock:
+                if ("kill_rank", (r, s)) not in _plan.fired:
+                    _plan.fired.append(("kill_rank", (r, s)))
+            _notify("kill_rank", rank)
+            return True
+    return False
+
+
+def heartbeat_silenced(rank, step_index):
+    """True while an armed ``silence_heartbeat=(rank, start, window)``
+    plan keeps simulated host ``rank`` mute (alive but unreachable)."""
+    if _plan is None or _plan.silence_heartbeat is None:
+        return False
+    r, start, window = _plan.silence_heartbeat
+    if rank != r or not (start <= step_index < start + window):
+        return False
+    with _plan._lock:
+        _plan.fired.append(("silence_heartbeat", (rank, step_index)))
+    return True
+
+
+def consume_transient_fault(step_index):
+    """One transient supervised-step fault; True while the armed budget
+    lasts at/after the armed wall step.  Each True consumes one unit of
+    ``fail_step_transient_count``, so retries genuinely re-attempt: a
+    count of 1 fails once and the in-place retry succeeds, a count
+    above the supervisor's retry ladder escalates to rollback."""
+    if _plan is None or not _plan.fail_step_transient:
+        return False
+    if step_index < _plan.fail_step_transient \
+            or _plan.fail_step_transient_count <= 0:
+        return False
+    with _plan._lock:
+        _plan.fail_step_transient_count -= 1
+        _plan.fired.append(("fail_step_transient", step_index))
+    _notify("fail_step_transient", step_index)
+    return True
 
 
 def serving_cancel_request(step_index):

@@ -54,6 +54,27 @@ def any_flag(flag):
     return bool(int(np.max(flags)))
 
 
+def min_int(value):
+    """Allgather an int and return the fleet-wide MINIMUM.
+
+    The elastic-restart agreement primitive: after a dead verdict every
+    surviving host computes the new world size from the peers IT can
+    still see; the fleet must restart at the smallest world any survivor
+    derived, or ranks would build incompatible meshes and wedge in the
+    first collective.  Single process: passthrough with no collective.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return int(value)
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    vals = multihost_utils.process_allgather(
+        np.asarray([int(value)], np.int64))
+    return int(np.min(vals))
+
+
 def broadcast_tag(name):
     """Broadcast a tag name (or None) from process 0 to every host.
 

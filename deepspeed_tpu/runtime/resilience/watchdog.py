@@ -57,6 +57,77 @@ class GracefulPreemption(RuntimeError):
         self.save_dir = save_dir
 
 
+# per-signal registry for chain_signal_handlers: ONE dispatcher per
+# signal fans out to every registered callback, then to whatever
+# non-deepspeed handler was installed before the first registration.
+# Bound methods are held as WEAKREFS, so an engine rebuilt per elastic
+# restart (or drained and dropped) is never pinned process-global by
+# its old SIGTERM hook — dead callbacks silently fall out of the chain.
+_SIGNAL_CHAINS = {}     # signum -> {"prev": handler, "cbs": [ref],
+#                                    "dispatcher": handler}
+
+
+def chain_signal_handlers(callback, signals=None):
+    """Register ``callback`` on each signal WITHOUT dropping what was
+    there: one dispatcher per signal invokes every registered callback
+    (newest first), then the prior non-deepspeed Python-level handler.
+    ``signal.signal`` is last-wins, so a process that hosts both a
+    training engine and a serving engine — or any client SIGTERM hook —
+    would silently lose every handler but the final one registered;
+    chaining makes ``install_preemption_handler`` safe to call from
+    multiple engines in one process.  Re-registering the same callback
+    is a no-op (no double-fire), bound methods are weakly referenced
+    (a dead engine's hook is dropped, not invoked), and non-callable
+    prior dispositions (SIG_DFL/SIG_IGN) are never chained.  Returns
+    the list of signal numbers installed.  Main thread only (a Python
+    signal-handler constraint)."""
+    import signal as signal_mod
+    import weakref
+
+    try:
+        ref = weakref.WeakMethod(callback)
+    except TypeError:
+        # plain functions/lambdas: hold strongly (their lifetime is the
+        # caller's business, and a lambda has no __self__ to outlive)
+        def ref(_cb=callback):
+            return _cb
+
+    sigs = tuple(signals) if signals else (signal_mod.SIGTERM,)
+    for s in sigs:
+        ent = _SIGNAL_CHAINS.get(s)
+        current = signal_mod.getsignal(s)
+        if ent is None or current is not ent["dispatcher"]:
+            # first registration, or someone installed their own handler
+            # over our dispatcher since: chain THAT as the new tail, and
+            # CARRY the already-registered callbacks into the new chain
+            # (they would otherwise be lost with the overridden
+            # dispatcher).  The old entry is emptied, not shared: if the
+            # foreign handler chained our old dispatcher as ITS tail,
+            # that dispatcher now fires only its own pre-us prev —
+            # every callback still fires exactly once.
+            carried = []
+            if ent is not None:
+                carried, ent["cbs"] = ent["cbs"], []
+            ent = {"prev": current, "cbs": carried}
+
+            def _dispatch(signum, frame, _ent=ent):
+                for r in list(_ent["cbs"]):
+                    cb = r()
+                    if cb is not None:
+                        cb()
+                if callable(_ent["prev"]):
+                    _ent["prev"](signum, frame)
+
+            ent["dispatcher"] = _dispatch
+            _SIGNAL_CHAINS[s] = ent
+            signal_mod.signal(s, _dispatch)
+        live = [r() for r in ent["cbs"]]
+        ent["cbs"] = [r for r, cb in zip(ent["cbs"], live) if cb is not None]
+        if callback not in [cb for cb in live if cb is not None]:
+            ent["cbs"].insert(0, ref)       # newest first
+    return list(sigs)
+
+
 class TrainingWatchdog:
     """Streak/stall detector.  Thresholds of 0 disable that detector."""
 

@@ -590,13 +590,20 @@ class InferenceEngine:
     def install_preemption_handler(self, signals=None) -> None:
         """Route SIGTERM (the preemption notice on TPU pods) into
         :meth:`request_drain` — the serving analog of the training
-        engine's ``install_preemption_handler``.  Main thread only (a
-        Python signal-handler constraint)."""
+        engine's ``install_preemption_handler``.  Any previously
+        installed Python-level handler is CHAINED, not replaced: a
+        process hosting BOTH a training engine and a serving engine
+        (the fine-tune-and-serve colocation) must graceful-preempt the
+        trainer AND drain the server on one SIGTERM — ``signal.signal``
+        alone is last-wins and silently dropped whichever handler
+        registered first.  Main thread only (a Python signal-handler
+        constraint)."""
         import signal as signal_mod
 
-        sigs = tuple(signals) if signals else (signal_mod.SIGTERM,)
-        for s in sigs:
-            signal_mod.signal(s, lambda *_a: self.request_drain())
+        from deepspeed_tpu.runtime.resilience.watchdog import \
+            chain_signal_handlers
+
+        sigs = chain_signal_handlers(self.request_drain, signals)
         logger.info("serving preemption handler installed for %s",
                     [signal_mod.Signals(s).name for s in sigs])
 

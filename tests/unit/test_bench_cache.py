@@ -39,9 +39,16 @@ def test_cfg_hash_stable_and_spec_sensitive():
     # rung of the same shape
     assert b._cfg_hash({"model": "gpt2-125m", "batch": 8,
                         "zero_stage": 3}, base) != h1
+    # the failure-injection rung (ISSUE 12) is its own config identity:
+    # a dead chaos attempt must not shadow the healthy rung of the same
+    # shape in the phase cache (and vice versa)
+    assert b._cfg_hash({"model": "gpt2-125m", "batch": 8,
+                        "chaos": "rank-kill"}, base) != h1
     with open(os.path.join(REPO, "bench.py")) as f:
         src = f.read()
     assert '"zero_stage": 3' in src, "bench ladder lost its stage-3 rung"
+    assert '"chaos": "rank-kill"' in src, \
+        "bench ladder lost its failure-injection rung"
 
 
 def test_cache_roundtrip_and_corruption_tolerance(tmp_path):

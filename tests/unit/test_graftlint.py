@@ -590,6 +590,80 @@ def test_host_sync_quiet_on_fleet_straight_line_handoff():
                 rules=["host-sync"]) == []
 
 
+HS_SUPERVISOR_TICK_BAD = """
+class TrainingSupervisor:
+    def _heartbeat_tick(self, w):
+        stale, dead = [], []
+        for h in self.hosts:
+            h.tick(w)
+            lag = float(jax.device_get(self.engine.state.step)) - h.last_beat
+            if lag > self.config.heartbeat_timeout_steps:
+                dead.append(h.rank)
+        return stale, dead
+"""
+
+HS_SUPERVISOR_ROLLBACK_BAD = """
+class TrainingSupervisor:
+    def _rollback(self, reason):
+        for _attempt in range(self.config.max_recovery_attempts):
+            _path, client = self.engine.load_checkpoint(
+                self.save_dir, tag=self.last_committed_tag, elastic=True)
+            for leaf in jax.tree_util.tree_leaves(self.engine.state.params):
+                leaf.block_until_ready()
+"""
+
+HS_SUPERVISOR_GOOD = """
+class TrainingSupervisor:
+    def tick(self):
+        self.wall_step += 1
+        stale, dead = self._heartbeat_tick(self.wall_step)
+        if dead and self._verdict(dead, self.wall_step):
+            self._elastic_restart(dead)
+            return
+        self.supervised_step()
+
+    def _heartbeat_tick(self, w):
+        stale, dead = [], []
+        for h in self.hosts:
+            h.tick(w)
+            lag = w - h.last_beat
+            if lag > self.config.heartbeat_timeout_steps:
+                dead.append(h.rank)
+            elif lag > 0:
+                stale.append(h.rank)
+        return stale, dead
+
+    def _rollback(self, reason):
+        for _attempt in range(self.config.max_recovery_attempts):
+            _path, client = self.engine.load_checkpoint(
+                self.save_dir, tag=self.last_committed_tag, elastic=True)
+            self._reseat_data(client)
+"""
+
+
+@pytest.mark.parametrize("src,label", [
+    (HS_SUPERVISOR_TICK_BAD, "_heartbeat_tick"),
+    (HS_SUPERVISOR_ROLLBACK_BAD, "_rollback"),
+])
+def test_host_sync_covers_supervisor_hot_fns(src, label):
+    """ISSUE 12 satellite: the training supervisor's detection tick and
+    recovery paths are hot — a device sync per simulated host (or per
+    state leaf mid-rollback) would serialize every wall step, failure
+    or not, against the host."""
+    got = lint(src, "deepspeed_tpu/runtime/resilience/supervisor.py",
+               rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"], label
+
+
+def test_host_sync_quiet_on_supervisor_host_only_loop():
+    # the real shape: pure host heartbeat bookkeeping and recovery
+    # retries that touch the device only through the engine's own
+    # load/init entry points — no findings
+    assert lint(HS_SUPERVISOR_GOOD,
+                "deepspeed_tpu/runtime/resilience/supervisor.py",
+                rules=["host-sync"]) == []
+
+
 def test_host_sync_quiet_on_host_only_reliability_fns():
     # the real implementations are pure host accounting: clock reads,
     # dict walks, journal appends — no findings
@@ -796,6 +870,41 @@ def test_disarmed_discipline_covers_arm_dispatch_path():
     assert rule_names(got) == ["disarmed-discipline"]
     assert "_arm_dispatch" in got[0].message
     assert lint(DISARM_DISPATCH_GOOD, rules=["disarmed-discipline"]) == []
+
+
+DISARM_SUPERVISOR_BAD = """
+class DeepSpeedEngine:
+    def _arm_supervisor(self, supervisor):
+        if not supervisor.save_dir or not self._resilience.atomic_checkpoints:
+            self._supervisor = None
+            return False
+        self._supervisor = supervisor
+        return True
+"""
+
+DISARM_SUPERVISOR_GOOD = """
+class DeepSpeedEngine:
+    def _arm_supervisor(self, supervisor):
+        if not supervisor.save_dir or not self._resilience.atomic_checkpoints:
+            self._supervisor = None
+            log_dist("self-healing supervision DISARMED - no committed-"
+                     "tag directory / atomic commits off; steps run "
+                     "unsupervised", ranks=[0], level=logging.WARNING)
+            return False
+        self._supervisor = supervisor
+        return True
+"""
+
+
+def test_disarmed_discipline_covers_arm_supervisor_path():
+    """ISSUE 12 satellite: the engine's supervision arming fn is held to
+    the armed-or-warns discipline — silently refusing to supervise (no
+    retry/rollback/elastic restart, run dies on the first fault) fires;
+    warning DISARMED naming the blockers quiets it."""
+    got = lint(DISARM_SUPERVISOR_BAD, rules=["disarmed-discipline"])
+    assert rule_names(got) == ["disarmed-discipline"]
+    assert "_arm_supervisor" in got[0].message
+    assert lint(DISARM_SUPERVISOR_GOOD, rules=["disarmed-discipline"]) == []
 
 
 # ---------------------------------------------------------------------------

@@ -45,6 +45,7 @@ HOT_FILES = {
     "deepspeed_tpu/serving/kv_cache.py",
     "deepspeed_tpu/serving/reliability.py",
     "deepspeed_tpu/serving/fleet.py",
+    "deepspeed_tpu/runtime/resilience/supervisor.py",
 }
 HOT_FN_RE = re.compile(
     r"^(train_batch|eval_batch|forward|backward|step"
@@ -64,7 +65,15 @@ HOT_FN_RE = re.compile(
     # blessed device touch, straight-line in _handoff_tick)
     r"|_step_replica|_place|_eligible|_migrate\w*|_handoff_tick"
     r"|_on_failure|_mark_dead|_retire_drained|drain_replica"
-    r"|has_work|export_request|import_request|adopt_running)$")
+    r"|has_work|export_request|import_request|adopt_running"
+    # training supervisor (ISSUE 12): the supervised loop runs these
+    # once per wall step — detection must stay pure host bookkeeping,
+    # and the recovery paths may touch the device only through the
+    # engine's own load/init entry points (a raw device sync in the
+    # heartbeat/verdict tick would serialize every step against the
+    # host even in the no-failure steady state)
+    r"|tick|supervised_step|_heartbeat_tick|_verdict|_rollback"
+    r"|_elastic_restart|_reseat_\w+)$")
 # benchmark drivers: every loop is (or brackets) a timed region — a sync
 # per iteration pollutes the measured step time with transfer latency
 BENCH_FILES = {"bench.py", "tools/pipe_bench.py", "tools/serve_bench.py"}

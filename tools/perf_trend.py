@@ -103,6 +103,15 @@ def trend_rows(rounds):
                 "hfu": mfu_rep.get("hfu"),
                 "step_ms": payload.get("step_ms"),
                 "tokens_per_sec": payload.get("tokens_per_sec"),
+                # recovery economics (ISSUE 12 --chaos rung): rounds
+                # without failure injection simply lack these keys and
+                # show as honest gaps, same as dead rounds — a None here
+                # must never be averaged into a goodput slope
+                "goodput_samples_per_wall_step":
+                    payload.get("goodput_samples_per_wall_step"),
+                "mttr_steps_mean": (payload.get("mttr_steps") or {}).get(
+                    "mean") if isinstance(payload.get("mttr_steps"), dict)
+                    else payload.get("mttr_steps"),
                 "trace": tel.get("trace"),
                 "metrics_jsonl": tel.get("metrics_jsonl"),
             })
@@ -149,7 +158,8 @@ def trend_payload(pattern=DEFAULT_GLOB, root=".",
     return {
         "rounds": [{k: r.get(k) for k in
                     ("round", "ok", "value", "unit", "mfu", "step_ms",
-                     "tokens_per_sec")} for r in rows],
+                     "tokens_per_sec", "goodput_samples_per_wall_step",
+                     "mttr_steps_mean")} for r in rows],
         "dead_rounds": [r["round"] for r in rows if not r["ok"]],
         "regression": check_regression(rows, threshold),
     }

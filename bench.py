@@ -542,6 +542,9 @@ def run_chaos_worker(args, jax, jnp, np, device_kind, platform, n_dev):
     from deepspeed_tpu.runtime.resilience.supervisor import \
         TrainingSupervisor
 
+    if args.chaos == "bitflip":
+        return run_bitflip_worker(args, jax, jnp, np, device_kind,
+                                  platform, n_dev)
     if args.chaos != "rank-kill":
         print(f"FATAL: unknown --chaos mode {args.chaos!r}",
               file=sys.stderr, flush=True)
@@ -638,6 +641,137 @@ def run_chaos_worker(args, jax, jnp, np, device_kind, platform, n_dev):
         "committed_samples": rep["committed_samples"],
         "wall_steps": rep["wall_steps"],
         "supervisor_armed": rep["armed"],
+        "wall_s": round(wall_s, 1),
+        "device_kind": device_kind, "platform": platform,
+        "n_devices": n_dev, "batch_per_chip": args.batch,
+    }), flush=True)
+    return 0
+
+
+def run_bitflip_worker(args, jax, jnp, np, device_kind, platform, n_dev):
+    """ISSUE 13 silent-corruption rung (``--chaos bitflip``): a
+    SUPERVISED run with the numerical-integrity defense armed, where one
+    dp rank's replica of a weight takes a single-bit flip mid-run.  The
+    published numbers are the DEFENSE economics — detection latency in
+    steps (anomaly/flip boundary -> corrupt verdict), a recovered flag
+    (the corrupted rank lost the cross-replica vote, recovery rolled
+    back to an integrity-clean tag and skipped the window, the run
+    completed), and the goodput cost of the skipped samples.  Rounds
+    without the rung lack the keys; tools/perf_trend.py shows them as
+    honest gaps."""
+    import shutil
+    import tempfile
+    import time as _t
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Model, gpt2_config
+    from deepspeed_tpu.runtime.resilience import chaos
+    from deepspeed_tpu.runtime.resilience.supervisor import \
+        TrainingSupervisor
+
+    if n_dev < 3:
+        print("FATAL: --chaos bitflip needs >= 3 devices — a 2-way "
+              "replica split is a tie the vote refuses to convict on",
+              file=sys.stderr, flush=True)
+        return 3
+    model_name = args.model if args.model.startswith("gpt2") else "gpt2-125m"
+    cfg = gpt2_config(model_name, n_positions=args.seq, dtype=jnp.bfloat16,
+                      remat=bool(args.remat), remat_policy=args.remat_policy,
+                      scan_layers=bool(args.scan_layers),
+                      loss_chunk_tokens=args.loss_chunk)
+    total = args.batch * n_dev * (args.steps + 8)
+    rng = np.random.default_rng(0)
+    data_ids = rng.integers(0, cfg.vocab_size, (total, args.seq))
+
+    def engine_factory(world):
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2Model(cfg), config_params={
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 2},
+                "mesh": {"data": world, "allow_partial": True},
+                "elasticity": {"enabled": True,
+                               "max_train_batch_size": args.batch * n_dev,
+                               "micro_batch_sizes": [args.batch],
+                               "min_gpus": 1, "max_gpus": n_dev,
+                               "version": 0.1},
+                # every-boundary vote: under GSPMD resharding a divergent
+                # replica is healed/propagated by the NEXT step, so the
+                # vote's detection window IS its cadence
+                "resilience": {"integrity": {"enabled": True,
+                                             "vote_every_steps": 1,
+                                             "min_history": 2}},
+                "steps_per_print": 10 ** 9})
+        return engine
+
+    def data_factory(engine):
+        rows = engine.train_micro_batch_size_per_gpu() \
+            * engine.dp_world_size
+
+        def gen():
+            i = 0
+            while True:
+                start = (i * rows) % total
+                sl = data_ids[start:start + rows]
+                if len(sl) < rows:
+                    i = 0
+                    continue
+                yield {"input_ids": sl, "labels": sl.copy()}
+                i += 1
+
+        return gen()
+
+    save_dir = tempfile.mkdtemp(prefix="bench_bitflip_")
+    try:
+        sup = TrainingSupervisor(
+            engine_factory, data_factory, save_dir=save_dir,
+            world_size=n_dev, config={"checkpoint_every_steps": 2})
+        sup.run(1)              # build state so a weight leaf is pickable
+        _phase("bitflip_warm")
+        flat = jax.tree_util.tree_leaves(sup.engine.state.params)
+        leaf = next(i for i, l in enumerate(flat) if l.ndim >= 2)
+        flip_at = max(3, args.steps // 2)
+        chaos.arm()
+        chaos.flip_bit(rank=n_dev - 1, step=flip_at, leaf=leaf, element=0)
+        t0 = _t.time()
+        sup.run(args.steps)
+        wall_s = _t.time() - t0
+        chaos.disarm()
+        rep = sup.report()
+        irep = sup.engine.telemetry_report()["integrity"]
+    finally:
+        chaos.disarm()
+        shutil.rmtree(save_dir, ignore_errors=True)
+    verdicts = irep["verdicts"]
+    recovered = bool(
+        rep["corrupt_verdicts"] >= 1 and rep["rollbacks"] >= 1
+        and rep["committed_steps"] >= args.steps
+        and any(v["culprits"] == [n_dev - 1] for v in verdicts))
+    _phase(f"bitflip_recovered:{recovered}")
+    if not recovered:
+        # the rung exists to price detection; an undetected flip (or an
+        # unrecovered run) must not publish a flawless latency number
+        print(f"FATAL: bitflip rung did not detect+recover "
+              f"(verdicts={verdicts}, rollbacks={rep['rollbacks']}) — "
+              f"refusing to publish", file=sys.stderr, flush=True)
+        return 3
+    latency = irep["detection_latency_steps"]["last"]
+    print(json.dumps({
+        "metric": f"silent-corruption defense, 1-bit flip on 1 of "
+                  f"{n_dev} ranks ({model_name} seq{args.seq})",
+        "value": max(1, int(latency) + 1),
+        "unit": "detection latency steps (floor 1 = same-boundary)",
+        "detection_latency_steps": int(latency),
+        "corruption_recovered": recovered,
+        "corrupt_verdicts": rep["corrupt_verdicts"],
+        "culprits": sorted({r for v in verdicts for r in v["culprits"]}),
+        "skipped_samples": rep["skipped_samples"],
+        "rollbacks": rep["rollbacks"],
+        "goodput_samples_per_wall_step":
+            round(rep["goodput_samples_per_wall_step"], 3),
+        "committed_steps": rep["committed_steps"],
+        "wall_steps": rep["wall_steps"],
+        "false_positives": irep["false_positives"],
         "wall_s": round(wall_s, 1),
         "device_kind": device_kind, "platform": platform,
         "n_devices": n_dev, "batch_per_chip": args.batch,
@@ -844,33 +978,42 @@ def _run_chaos_rung(worker, args, payload, record):
     ``goodput_samples_per_wall_step`` + ``mttr_steps`` become top-level
     keys (tools/perf_trend.py trends them; rounds where this rung fails
     carry a ``chaos: {error}`` stanza instead — an honest gap)."""
-    # every worker-selection key is PINNED: the rung must reach
-    # run_chaos_worker whatever the base round measured (an inherited
+    # every worker-selection key is PINNED: the rung must reach its
+    # chaos worker whatever the base round measured (an inherited
     # onebit/sparse/offload flag would dispatch a different worker and
     # record ITS output as a bogus chaos success)
-    chaos_spec = {"model": "gpt2-125m", "batch": 4, "seq": 256,
-                  "steps": 12, "remat": 0, "chaos": "rank-kill",
-                  "onebit": 0, "sparse": 0, "offload": 0, "zero_stage": 2,
-                  "timeout": 300}
-    ckey = _cfg_hash(chaos_spec, args)
-    try:
-        rc, stdout, _err, phases, timed_out = worker.run(
-            chaos_spec, args, chaos_spec["timeout"])
-        if rc == 0 and stdout.strip():
-            cp = json.loads(stdout.strip().splitlines()[-1])
-            payload["chaos"] = cp
-            for k in ("goodput_samples_per_wall_step", "mttr_steps"):
-                payload[k] = cp.get(k)
-            record(ckey, ok=True, value=cp.get("value"),
-                   last_phase=phases[-1][0] if phases else "dispatch")
-        else:
-            payload["chaos"] = {"error": f"chaos rung rc={rc} "
-                                         f"timed_out={timed_out}"}
-            record(ckey, ok=False, timed_out=timed_out,
-                   last_phase=phases[-1][0] if phases else "dispatch")
-    except Exception as e:  # lint: allow-broad-except — the recovery
-        # rung must never eat the round's headline number
-        payload["chaos"] = {"error": str(e)}
+    base = {"model": "gpt2-125m", "batch": 4, "seq": 256,
+            "steps": 12, "remat": 0,
+            "onebit": 0, "sparse": 0, "offload": 0, "zero_stage": 2,
+            "timeout": 300}
+    rungs = [
+        # ISSUE 12: rank death -> elastic restart economics
+        ("chaos", {**base, "chaos": "rank-kill"},
+         ("goodput_samples_per_wall_step", "mttr_steps")),
+        # ISSUE 13: silent single-bit flip -> detection economics
+        ("chaos_bitflip", {**base, "chaos": "bitflip"},
+         ("detection_latency_steps", "corruption_recovered")),
+    ]
+    for stanza, chaos_spec, merge_keys in rungs:
+        ckey = _cfg_hash(chaos_spec, args)
+        try:
+            rc, stdout, _err, phases, timed_out = worker.run(
+                chaos_spec, args, chaos_spec["timeout"])
+            if rc == 0 and stdout.strip():
+                cp = json.loads(stdout.strip().splitlines()[-1])
+                payload[stanza] = cp
+                for k in merge_keys:
+                    payload[k] = cp.get(k)
+                record(ckey, ok=True, value=cp.get("value"),
+                       last_phase=phases[-1][0] if phases else "dispatch")
+            else:
+                payload[stanza] = {"error": f"chaos rung rc={rc} "
+                                            f"timed_out={timed_out}"}
+                record(ckey, ok=False, timed_out=timed_out,
+                       last_phase=phases[-1][0] if phases else "dispatch")
+        except Exception as e:  # lint: allow-broad-except — the recovery
+            # rung must never eat the round's headline number
+            payload[stanza] = {"error": str(e)}
 
 
 def run_parent(args) -> int:
@@ -1146,11 +1289,15 @@ def main():
                    help="ZeRO stage for the training bench; 3 runs the "
                         "scheduled-vs-implicit gather A/B "
                         "(run_stage3_worker)")
-    p.add_argument("--chaos", default="", choices=["", "rank-kill"],
+    p.add_argument("--chaos", default="",
+                   choices=["", "rank-kill", "bitflip"],
                    help="failure-injection rung (run_chaos_worker): "
                         "'rank-kill' hard-kills one simulated host "
                         "mid-run under TrainingSupervisor and records "
-                        "goodput samples/wall-step + MTTR steps")
+                        "goodput samples/wall-step + MTTR steps; "
+                        "'bitflip' flips one bit of one dp rank's weight "
+                        "replica and records detection-latency-steps + "
+                        "recovered flag (ISSUE 13)")
     p.add_argument("--onebit", type=int, default=0,
                    help="BASELINE config 5: OneBitAdam wire path, warmup vs "
                         "post-freeze step time")

@@ -354,6 +354,52 @@ class DeepSpeedResilienceConfig:
                     f"resilience.supervisor.{label} must be >= 1 (the "
                     f"supervisor needs at least one recovery attempt to "
                     f"recover at all), got {val}")
+        integ = d.get(RESILIENCE_INTEGRITY, {})
+        self.integrity_enabled = bool(integ.get(INTEGRITY_ENABLED,
+                                                INTEGRITY_ENABLED_DEFAULT))
+        self.integrity_window = int(integ.get(INTEGRITY_WINDOW,
+                                              INTEGRITY_WINDOW_DEFAULT))
+        self.integrity_z_threshold = float(
+            integ.get(INTEGRITY_Z_THRESHOLD, INTEGRITY_Z_THRESHOLD_DEFAULT))
+        self.integrity_min_history = int(
+            integ.get(INTEGRITY_MIN_HISTORY, INTEGRITY_MIN_HISTORY_DEFAULT))
+        self.integrity_confirm_steps = int(
+            integ.get(INTEGRITY_CONFIRM_STEPS,
+                      INTEGRITY_CONFIRM_STEPS_DEFAULT))
+        self.integrity_clear_steps = int(
+            integ.get(INTEGRITY_CLEAR_STEPS, INTEGRITY_CLEAR_STEPS_DEFAULT))
+        self.integrity_vote_every_steps = int(
+            integ.get(INTEGRITY_VOTE_EVERY, INTEGRITY_VOTE_EVERY_DEFAULT))
+        self.integrity_dup_check_every_steps = int(
+            integ.get(INTEGRITY_DUP_CHECK_EVERY,
+                      INTEGRITY_DUP_CHECK_EVERY_DEFAULT))
+        self.integrity_quarantine_after = int(
+            integ.get(INTEGRITY_QUARANTINE_AFTER,
+                      INTEGRITY_QUARANTINE_AFTER_DEFAULT))
+        if self.integrity_window < 2:
+            raise ValueError(
+                f"resilience.integrity.{INTEGRITY_WINDOW} must be >= 2 "
+                f"steps (a shorter window has no variance to score "
+                f"against), got {self.integrity_window}")
+        if self.integrity_z_threshold <= 0:
+            raise ValueError(
+                f"resilience.integrity.{INTEGRITY_Z_THRESHOLD} must be "
+                f"> 0 (0 would flag every step as corrupt), got "
+                f"{self.integrity_z_threshold}")
+        for label, val, lo in (
+                (INTEGRITY_MIN_HISTORY, self.integrity_min_history, 1),
+                (INTEGRITY_CONFIRM_STEPS, self.integrity_confirm_steps, 1),
+                (INTEGRITY_CLEAR_STEPS, self.integrity_clear_steps, 1),
+                (INTEGRITY_QUARANTINE_AFTER,
+                 self.integrity_quarantine_after, 1),
+                (INTEGRITY_VOTE_EVERY,
+                 self.integrity_vote_every_steps, 0),
+                (INTEGRITY_DUP_CHECK_EVERY,
+                 self.integrity_dup_check_every_steps, 0)):
+            if val < lo:
+                raise ValueError(
+                    f"resilience.integrity.{label} must be >= {lo}, "
+                    f"got {val}")
 
 
 def get_resilience_config(param_dict):

@@ -228,6 +228,37 @@ SUPERVISOR_MAX_RESTARTS_DEFAULT = 4
 SUPERVISOR_CHECKPOINT_EVERY = "checkpoint_every_steps"  # commit cadence; 0=off
 SUPERVISOR_CHECKPOINT_EVERY_DEFAULT = 1
 
+# resilience.integrity sub-block: silent-corruption defense (runtime/
+# resilience/integrity.py, ISSUE 13) — device-side step sentinels with a
+# host EMA/z-score window, cross-replica checksum vote, duplicate-compute
+# sentinel micro-step.  Opt-in: the armed step jits carry extra (cheap)
+# norm outputs, so the master switch defaults off and disarmed runs are
+# bit-identical at zero extra compiles (tier-1 pin).
+RESILIENCE_INTEGRITY = "integrity"
+INTEGRITY_ENABLED = "enabled"                   # master switch
+INTEGRITY_ENABLED_DEFAULT = False
+INTEGRITY_WINDOW = "window"                     # EMA window, steps
+INTEGRITY_WINDOW_DEFAULT = 32
+INTEGRITY_Z_THRESHOLD = "z_threshold"           # |z| past this = anomaly
+INTEGRITY_Z_THRESHOLD_DEFAULT = 6.0
+INTEGRITY_MIN_HISTORY = "min_history"           # steps before z can fire
+INTEGRITY_MIN_HISTORY_DEFAULT = 4
+INTEGRITY_CONFIRM_STEPS = "confirm_steps"       # anomalous steps before a
+# sentinel-only (no-culprit) corrupt verdict
+INTEGRITY_CONFIRM_STEPS_DEFAULT = 2
+INTEGRITY_CLEAR_STEPS = "clear_steps"           # normal steps that close
+# an unconfirmed anomaly as a false positive
+INTEGRITY_CLEAR_STEPS_DEFAULT = 2
+INTEGRITY_VOTE_EVERY = "vote_every_steps"       # background vote; 0 = only
+# on sentinel anomaly
+INTEGRITY_VOTE_EVERY_DEFAULT = 16
+INTEGRITY_DUP_CHECK_EVERY = "dup_check_every_steps"  # duplicate-compute
+# sentinel micro-step cadence; 0 = off (costs one extra fwd+bwd)
+INTEGRITY_DUP_CHECK_EVERY_DEFAULT = 0
+INTEGRITY_QUARANTINE_AFTER = "quarantine_after"  # corrupt verdicts on one
+# rank before the supervisor quarantines it (elastic restart without it)
+INTEGRITY_QUARANTINE_AFTER_DEFAULT = 2
+
 #############################################
 # Telemetry (TPU extension): structured step tracing, unified metrics
 # stream, measured-vs-analytic MFU accounting (deepspeed_tpu/telemetry/)

@@ -70,6 +70,12 @@ class TrainState(NamedTuple):
 
 
 class DeepSpeedEngine:
+    # subclasses whose step path cannot drive the numerical-integrity
+    # defense (ISSUE 13) override this to False — _arm_integrity then
+    # DISARM-warns instead of arming a monitor nothing would feed
+    # (a class flag, not a name check, so SUBCLASSES inherit the block)
+    _integrity_armable = True
+
     def __init__(self, args=None, model=None, optimizer=None,
                  model_parameters=None, training_data=None, lr_scheduler=None,
                  mpu=None, dist_init_required=None, collate_fn=None,
@@ -85,6 +91,10 @@ class DeepSpeedEngine:
         self.mpu = mpu
         self.global_steps = 0
         self.micro_steps = 0
+        # samples the integrity ladder deliberately skipped (PaLM-style
+        # rollback-and-skip, ISSUE 13): biases reshard.data_position so
+        # the stream offset stays truthful; persisted with checkpoints
+        self.samples_skipped = 0
         self.gradient_average = True
         self.warn_unscaled_loss = True
 
@@ -184,6 +194,10 @@ class DeepSpeedEngine:
 
         # --- telemetry (ISSUE 10) -----------------------------------------
         self._arm_telemetry()
+
+        # --- numerical integrity (ISSUE 13) -------------------------------
+        # after telemetry so the monitor can claim its tracer lane
+        self._arm_integrity()
 
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
@@ -1103,6 +1117,10 @@ class DeepSpeedEngine:
             # recovery accounting (ISSUE 12): incident ledger, MTTR,
             # downtime spans, goodput-samples-per-wall-step
             report["recovery"] = self._supervisor.report()
+        if self._integrity is not None:
+            # numerical-integrity accounting (ISSUE 13): anomaly/vote
+            # ledger, detection latency, false-positive counters
+            report["integrity"] = self._integrity.report()
         tel = self._telemetry
         if tel is None:
             return report
@@ -1871,6 +1889,18 @@ class DeepSpeedEngine:
         optimizer = self.optimizer
         mixed = self.mixed_precision
         compute_dtype = self.compute_dtype
+        # integrity sentinels (ISSUE 13): a build-time Python flag, so a
+        # disarmed engine compiles the EXACT pre-integrity program
+        # (bit-identical, zero extra compiles — tier-1 pin); an armed one
+        # adds the global grad norm + update/param-norm ratio as extra
+        # jit outputs riding the existing metrics dict
+        sentinels = self._integrity is not None \
+            and self._integrity.sentinels_armed
+
+        def _tree_norm(tree):
+            return jnp.sqrt(sum(
+                jnp.sum(jnp.square(l.astype(jnp.float32)))
+                for l in jax.tree_util.tree_leaves(tree)))
 
         def apply(state: TrainState, lr):
             scale = state.scaler.loss_scale if state.scaler is not None else jnp.float32(1.0)
@@ -1888,31 +1918,47 @@ class DeepSpeedEngine:
                         for g in jax.tree_util.tree_leaves(grads)))
                     factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
                     grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
+                elif sentinels:
+                    # the sentinel wants the global norm even unclipped
+                    gnorm = _tree_norm(grads)
                 else:
                     gnorm = jnp.float32(0.0)
                 master = st.master if mixed else st.params
                 new_master, new_opt = optimizer.update(
                     grads, st.opt_state, master, lr=lr)
+                extras = gnorm
+                if sentinels:
+                    delta = jax.tree_util.tree_map(
+                        lambda n, o: n.astype(jnp.float32)
+                        - o.astype(jnp.float32), new_master, master)
+                    extras = (gnorm, _tree_norm(delta)
+                              / (_tree_norm(master) + 1e-12))
                 if mixed:
                     new_params = jax.tree_util.tree_map(
                         lambda l: l.astype(compute_dtype), new_master)
                     return st._replace(params=new_params, master=new_master,
-                                       opt_state=new_opt, step=st.step + 1), gnorm
+                                       opt_state=new_opt, step=st.step + 1), extras
                 return st._replace(params=new_master, opt_state=new_opt,
-                                   step=st.step + 1), gnorm
+                                   step=st.step + 1), extras
 
             def skip_update(st):
+                zero = jnp.float32(0.0)
                 return st._replace(skipped_steps=st.skipped_steps + 1,
-                                   step=st.step + 1), jnp.float32(0.0)
+                                   step=st.step + 1), \
+                    ((zero, zero) if sentinels else zero)
 
-            new_state, gnorm = jax.lax.cond(overflow, skip_update, do_update, state)
+            new_state, extras = jax.lax.cond(overflow, skip_update, do_update, state)
+            gnorm = extras[0] if sentinels else extras
             if state.scaler is not None:
                 new_scaler = update_loss_scale(new_state.scaler, overflow, **scaler_hp)
                 new_state = new_state._replace(scaler=new_scaler)
             zero_accum = jax.tree_util.tree_map(jnp.zeros_like, new_state.accum)
             new_state = new_state._replace(accum=zero_accum, micro_step=jnp.int32(0))
-            return new_state, {"overflow": overflow, "grad_norm": gnorm,
-                               "loss_scale": scale}
+            metrics = {"overflow": overflow, "grad_norm": gnorm,
+                       "loss_scale": scale}
+            if sentinels:
+                metrics["update_ratio"] = extras[1]
+            return new_state, metrics
 
         return apply
 
@@ -2671,11 +2717,28 @@ class DeepSpeedEngine:
         self._last_metrics = metrics = self._annotate_comm(metrics)
         self._last_grad_norm = metrics["grad_norm"]
         overflow = None
+        observe_loss = self._pending_loss
+        mon = self._integrity
+        if mon is not None:
+            # integrity sentinels ride the step's ONE batched fetch; the
+            # watchdog downstream gets the HOST loss value, never a
+            # second device transfer of what this fetch already paid for
+            fetched = jax.device_get((metrics["overflow"],
+                                      self._pending_loss,
+                                      metrics["grad_norm"],
+                                      metrics["update_ratio"]))
+            overflow = bool(fetched[0])
+            observe_loss = None if fetched[1] is None else float(fetched[1])
+            mon.observe_step(
+                self.global_steps, loss=observe_loss,
+                grad_norm=float(fetched[2]),
+                update_ratio=float(fetched[3]), overflow=overflow)
         if self.fp16_enabled():
             # overflow must be visible when it happens (reference
             # fused_optimizer.py logs every skipped step); one small scalar
             # fetch on the already-host-driven non-fused path
-            overflow = bool(jax.device_get(metrics["overflow"]))
+            if overflow is None:
+                overflow = bool(jax.device_get(metrics["overflow"]))
             if overflow:
                 if tr is not None:
                     # loss-scale event: the scaler halves on this skip
@@ -2686,9 +2749,9 @@ class DeepSpeedEngine:
                     f"reducing loss scale to "
                     f"{float(jax.device_get(new_state.scaler.loss_scale)):g}",
                     ranks=[0])
-        elif self._watchdog is not None:
+        elif self._watchdog is not None and overflow is None:
             overflow = bool(jax.device_get(metrics["overflow"]))
-        self._observe_step_outcome(loss=self._pending_loss,
+        self._observe_step_outcome(loss=observe_loss,
                                    overflow=overflow)
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps)
@@ -2718,6 +2781,16 @@ class DeepSpeedEngine:
         import jax
         import jax.numpy as jnp
 
+        from deepspeed_tpu.runtime.resilience import chaos as _chaos
+
+        if _chaos.active() is not None:
+            # silent-corruption chaos (ISSUE 13): an armed spike_loss
+            # plan scales THIS batch host-side — finite anomalous data
+            batch = _chaos.maybe_spike_batch(batch, self.global_steps + 1)
+        if self._integrity is not None:
+            # cache a host reference to the step's first micro for the
+            # duplicate-compute sentinel (O(1), no copy, no device work)
+            self._integrity.note_micro(_first_micro(batch))
         if self._offload:
             # apply runs on host: micro-loop on device; each micro's grad
             # shards D2H-copy asynchronously while the NEXT micro computes
@@ -2782,14 +2855,30 @@ class DeepSpeedEngine:
         self._last_metrics = metrics = self._annotate_comm(metrics)
         self._last_grad_norm = metrics["grad_norm"]
         self.tput_timer.stop()
-        # the fused path never syncs host-side; the overflow scalar is only
-        # fetched when a watchdog is armed (one small device_get per step)
+        # the fused path never syncs host-side; the per-step scalars are
+        # only fetched when a watchdog or the integrity monitor is armed
+        # — and then as ONE batched device_get (the integrity sentinels
+        # RIDE the existing fetch; no second host sync per step)
         overflow = None
-        if self._watchdog is not None:
+        observe_loss = None
+        mon = self._integrity
+        if mon is not None:
+            fetched = jax.device_get((metrics["overflow"], metrics["loss"],
+                                      metrics["grad_norm"],
+                                      metrics["update_ratio"]))
+            overflow = bool(fetched[0])
+            # the watchdog's NaN check downstream gets the HOST value —
+            # handing it the device array would force a SECOND per-step
+            # transfer of the loss this fetch just paid for
+            observe_loss = float(fetched[1])
+            mon.observe_step(self.global_steps, loss=observe_loss,
+                             grad_norm=float(fetched[2]),
+                             update_ratio=float(fetched[3]),
+                             overflow=overflow)
+        elif self._watchdog is not None:
             overflow = bool(jax.device_get(metrics["overflow"]))
-        self._observe_step_outcome(
-            loss=metrics["loss"] if self._watchdog is not None else None,
-            overflow=overflow)
+            observe_loss = metrics["loss"]
+        self._observe_step_outcome(loss=observe_loss, overflow=overflow)
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps)
         return metrics["loss"]
@@ -2856,7 +2945,56 @@ class DeepSpeedEngine:
         # step boundary after the background seal lands — the commit
         # becomes visible without waiting for the next save/wait call
         if self._pending_commit is not None:
-            self._finalize_pending_commit(wait=False)
+            if self._supervisor is not None:
+                # supervised runs hold the commit-failure contract: a
+                # failed seal/publish (disk full, kill mid-commit) must
+                # not become a step crash the ladder answers with a
+                # rollback — the atomic layout guarantees no torn tag
+                # became visible, so training continues and the failure
+                # is counted (the previous PUBLISHED tag stays the
+                # rollback target)
+                try:
+                    self._finalize_pending_commit(wait=False)
+                except Exception as e:  # lint: allow-broad-except —
+                    # see contract above; unsupervised runs keep the
+                    # raise-at-step-boundary behavior
+                    self._supervisor.on_commit_failed(e)
+            else:
+                self._finalize_pending_commit(wait=False)
+        from deepspeed_tpu.runtime.resilience import chaos
+
+        if chaos.active() is not None:
+            # silent-corruption chaos (ISSUE 13): armed bit flips land on
+            # the just-committed state at the step boundary — AFTER this
+            # step's sentinel fetch, so detection starts next step (or at
+            # this boundary's vote)
+            from deepspeed_tpu.runtime.resilience import \
+                integrity as integrity_mod
+
+            integrity_mod.apply_chaos_faults(self)
+        if self._integrity is not None and self._supervisor is None:
+            # unsupervised escalation: without a TrainingSupervisor there
+            # is no rollback ladder, so a confirmed corrupt verdict
+            # becomes a watchdog event (abort -> emergency checkpoint,
+            # stamped integrity-suspect by the open anomaly window)
+            verdict = self._integrity.decide(self, self.global_steps)
+            if verdict is not None:
+                if self._watchdog is not None:
+                    from deepspeed_tpu.runtime.resilience.watchdog import \
+                        WatchdogAlarm
+
+                    try:
+                        self._watchdog.observe_integrity(self.global_steps,
+                                                         verdict)
+                    except WatchdogAlarm as alarm:
+                        self._emergency_checkpoint(alarm.event)
+                        raise
+                else:
+                    logger.warning(
+                        f"integrity: corrupt verdict at step "
+                        f"{self.global_steps} with no supervisor and no "
+                        f"watchdog armed — nothing will recover this run; "
+                        f"verdict: {verdict}")
         if overflow is not None:
             self._consecutive_skips = \
                 self._consecutive_skips + 1 if overflow else 0
@@ -2899,6 +3037,84 @@ class DeepSpeedEngine:
                 self._emergency_checkpoint(alarm.event)
                 raise
         self._maybe_preempt()
+
+    # ------------------------------------------------------------------
+    # numerical integrity (runtime/resilience/integrity.py, ISSUE 13)
+    # ------------------------------------------------------------------
+    def _arm_integrity(self):
+        """Arm the silent-corruption defense when ``resilience.
+        integrity.enabled`` asks for it, or warn DISARMED naming every
+        blocker.  Armed engines compute the step sentinels (loss, global
+        grad norm, update/param-norm ratio) INSIDE the step jits and
+        fetch them with the existing one-per-step batched device read —
+        no new host syncs; the cross-replica vote / duplicate-compute
+        jits compile lazily on their cadence, never on the step path.
+        Disarmed engines hold ``self._integrity = None``: the compiled
+        step programs are UNTOUCHED (bit-identical, zero extra compiles
+        — tier-1 pin)."""
+        self._integrity = None
+        res = self._resilience
+        if not res.integrity_enabled:
+            return
+        from deepspeed_tpu.runtime.resilience.integrity import (
+            IntegrityConfig, IntegrityMonitor)
+
+        blockers = []
+        if not self._integrity_armable:
+            blockers.append(
+                "PipelineEngine (per-stage params have no cross-stage "
+                "'data' replica to vote over, and the pipe interpreter's "
+                "stat fetch predates the sentinel plumbing)")
+        if self._offload:
+            blockers.append(
+                "cpu_offload=true (the optimizer steps on HOST master "
+                "shards — there is no device-resident replicated state "
+                "for the vote, and the sentinel norms would add host "
+                "passes to the streaming grad path)")
+        if self._onebit_wire():
+            blockers.append(
+                "1-bit Adam wire compression (the shard_map'd update "
+                "tail has no per-leaf norm outputs; error-feedback "
+                "state is deliberately rank-local, which the vote would "
+                "misread as corruption)")
+        if blockers:
+            log_dist(
+                f"numerical-integrity defense DISARMED — "
+                f"{'; '.join(blockers)}; silent corruption in this "
+                f"configuration is only caught by the NaN/overflow "
+                f"watchdog", ranks=[0], level=logging.WARNING)
+            return
+        cfg = IntegrityConfig.from_resilience(res)
+        dp = self.dp_world_size
+        vote_armed = True
+        vote_blockers = []
+        if dp <= 1:
+            vote_blockers.append(
+                "dp=1 (a single replica has nobody to disagree with)")
+        if self.zero_optimization_stage() >= 3:
+            vote_blockers.append(
+                "zero stage 3 (params are ZeRO-sharded at rest — no "
+                "replicated redundancy; sharded-state corruption "
+                "propagates symmetrically and is caught by the "
+                "sentinels instead)")
+        if vote_blockers:
+            vote_armed = False
+            log_dist(
+                f"integrity cross-replica vote DISARMED — "
+                f"{'; '.join(vote_blockers)}; sentinels-only (anomalies "
+                f"roll back without a culprit rank)",
+                ranks=[0], level=logging.WARNING)
+        dup_armed = vote_armed and cfg.dup_check_every_steps > 0
+        self._integrity = IntegrityMonitor(
+            cfg, dp, sentinels_armed=True, vote_armed=vote_armed,
+            dup_armed=dup_armed, tracer=self._tracer)
+        log_dist(
+            f"numerical-integrity defense armed: sentinels "
+            f"(z>{cfg.z_threshold:g} over a {cfg.window}-step window), "
+            f"cross-replica vote={'on' if vote_armed else 'off'}, "
+            f"duplicate-compute check="
+            f"{'every %d steps' % cfg.dup_check_every_steps if dup_armed else 'off'}",
+            ranks=[0])
 
     # ------------------------------------------------------------------
     # self-healing supervision (runtime/resilience/supervisor.py, ISSUE 12)
@@ -3322,7 +3538,7 @@ class DeepSpeedEngine:
         where the sample stream stood (resilience/reshard.py)."""
         from deepspeed_tpu.runtime.resilience import reshard
 
-        return {
+        meta = {
             "tag": str(tag),
             "global_steps": self.global_steps,
             "micro_steps": self.micro_steps,
@@ -3334,6 +3550,13 @@ class DeepSpeedEngine:
             reshard.TOPOLOGY_KEY: reshard.topology_manifest(self),
             reshard.DATA_POSITION_KEY: reshard.data_position(self),
         }
+        if self._integrity is not None:
+            # integrity stamp (ISSUE 13): a tag committed INSIDE an
+            # unresolved anomaly window holds bytes that verify but
+            # numbers that are suspect — auto-resume and the supervisor's
+            # rollback-target selection both fall back past it
+            meta["integrity_clean"] = bool(self._integrity.clean())
+        return meta
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True, backend=None, manifest_meta=None,
@@ -3399,9 +3622,17 @@ class DeepSpeedEngine:
                                     fsync=res.fsync)
                 self._pending_commit = PendingCommit(
                     commit, write_fn, heartbeat=hb).start()
-            self._pending_commit_info = {"save_dir": save_dir,
-                                         "tag": str(tag),
-                                         "backend": backend_r}
+            self._pending_commit_info = {
+                "save_dir": save_dir, "tag": str(tag),
+                "backend": backend_r,
+                # the supervisor's published-tag tracking (ISSUE 13
+                # async-cadence satellite): only a PUBLISHED tag is a
+                # rollback target, and its integrity stamp was fixed at
+                # commit time, not publish time
+                "global_steps": int(meta.get("global_steps",
+                                             self.global_steps)),
+                "integrity_clean": bool(meta.get("integrity_clean", True)),
+            }
             self._ckpt_foreground_ms = (_time.perf_counter() - t0) * 1000.0
             self._publish_ckpt_metrics()
             if self._tracer is not None:
@@ -3665,6 +3896,12 @@ class DeepSpeedEngine:
                     protect={info["tag"]})
         if self._watchdog is not None:
             self._watchdog.heartbeat()
+        if self._supervisor is not None:
+            # published-tag notification (ISSUE 13 async-cadence
+            # satellite): the supervisor tracks only PUBLISHED tags as
+            # rollback targets — a sealed-but-unpublished commit is not
+            # durable-visible and must never be a recovery destination
+            self._supervisor.on_commit_published(dict(info))
         log_dist(f"Committed async checkpoint "
                  f"{os.path.join(info['save_dir'], info['tag'])} "
                  f"(backend={info['backend']}, atomic)", ranks=[0])
@@ -3858,6 +4095,7 @@ class DeepSpeedEngine:
             "state": self.state,
             "global_steps": self.global_steps,
             "micro_steps": self.micro_steps,
+            "samples_skipped": self.samples_skipped,
             "onebit_latch": getattr(self, "_onebit_frozen_latch", False),
             "host_master": getattr(self, "_host_master_flat", None),
             "host_opt": dict(self._host_opt)
@@ -3890,6 +4128,7 @@ class DeepSpeedEngine:
         self.state = snap["state"]
         self.global_steps = snap["global_steps"]
         self.micro_steps = snap["micro_steps"]
+        self.samples_skipped = snap["samples_skipped"]
         self._onebit_frozen_latch = snap["onebit_latch"]
         if snap["host_master"] is not None:
             self._host_master_flat = snap["host_master"]
@@ -3980,6 +4219,14 @@ class DeepSpeedEngine:
 
         self.global_steps = meta["global_steps"]
         self.micro_steps = meta["micro_steps"]
+        # skipped-data bias (ISSUE 13 rollback-and-skip): restore the
+        # stream offset the tag recorded — a resume must fast-forward
+        # past both the trained AND the deliberately skipped samples
+        from deepspeed_tpu.runtime.resilience import reshard as _reshard
+
+        self.samples_skipped = int(
+            (meta.get(_reshard.DATA_POSITION_KEY) or {})
+            .get("samples_skipped", 0))
         # the 1-bit freeze phase latches on optimizer steps; a rollback to a
         # pre-freeze tag must re-derive it from the restored counters, not
         # keep serving the compressed program through what is warmup again

@@ -21,12 +21,18 @@ collective.  This package makes those survivable:
   step-clock heartbeat failure detection, coordinated dead verdicts,
   and a bounded retry / rollback / elastic-restart ladder with MTTR
   and goodput accounting.
+- ``integrity``: the silent-corruption defense — device-side step
+  sentinels (EMA/z-score), a cross-replica checksum vote that convicts
+  the corrupted rank by minority, a duplicate-compute sentinel
+  micro-step, and the ``corrupt`` verdict the supervisor answers with
+  rollback-and-skip / rank quarantine.
 """
 from deepspeed_tpu.runtime.resilience.atomic import (MANIFEST_NAME,
                                                      CheckpointCorrupt,
                                                      atomic_tag, gc_tags,
                                                      is_emergency_tag,
                                                      is_preempt_tag,
+                                                     is_suspect_tag,
                                                      list_tags, load_manifest,
                                                      read_latest,
                                                      read_topology,
@@ -34,6 +40,9 @@ from deepspeed_tpu.runtime.resilience.atomic import (MANIFEST_NAME,
                                                      select_resume_tag,
                                                      verify_tag, write_latest,
                                                      write_manifest)
+from deepspeed_tpu.runtime.resilience.integrity import (IntegrityConfig,
+                                                        IntegrityMonitor,
+                                                        classify_digests)
 from deepspeed_tpu.runtime.resilience.supervisor import (SupervisorConfig,
                                                          SupervisorGaveUp,
                                                          TrainingSupervisor,
@@ -46,11 +55,12 @@ from deepspeed_tpu.runtime.resilience.watchdog import (GracefulPreemption,
 
 __all__ = [
     "MANIFEST_NAME", "CheckpointCorrupt", "atomic_tag", "gc_tags",
-    "is_emergency_tag", "is_preempt_tag", "list_tags", "load_manifest",
-    "read_latest", "read_topology", "resume_candidates",
+    "is_emergency_tag", "is_preempt_tag", "is_suspect_tag", "list_tags",
+    "load_manifest", "read_latest", "read_topology", "resume_candidates",
     "select_resume_tag", "verify_tag", "write_latest", "write_manifest",
     "GracefulPreemption", "TrainingWatchdog", "WatchdogAlarm",
     "WatchdogEvent", "chain_signal_handlers",
     "SupervisorConfig", "SupervisorGaveUp", "TrainingSupervisor",
     "TransientStepFault",
+    "IntegrityConfig", "IntegrityMonitor", "classify_digests",
 ]

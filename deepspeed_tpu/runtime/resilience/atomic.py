@@ -656,6 +656,29 @@ def _emergency_from_manifest(tag, manifest):
     return str(tag).startswith("emergency_")
 
 
+def _suspect_from_manifest(tag, manifest):
+    """True for tags whose manifest records ``integrity_clean: false`` —
+    committed INSIDE an unresolved numerical-integrity anomaly window
+    (ISSUE 13).  The payload bytes verify fine (the checksums protect
+    the write path, not the numbers), but the NUMBERS are suspect, so
+    auto-resume must fall back past them the same way it falls back
+    past corrupt tags.  Absent stamp (integrity disarmed / older tags)
+    = not suspect."""
+    return manifest is not None and manifest.get("integrity_clean") is False
+
+
+def _resume_rank(tag, manifest):
+    """Resume-candidate ordering class: healthy tags first, then
+    integrity-suspect tags, then the watchdog's emergency snapshots
+    (known possibly-diverged state — last resort, unchanged from the
+    pre-integrity ordering)."""
+    if _emergency_from_manifest(tag, manifest):
+        return 2
+    if _suspect_from_manifest(tag, manifest):
+        return 1
+    return 0
+
+
 def read_topology(tag_dir):
     """The tag's topology manifest (mesh/zero/pipe/schedule layout the
     writing run used — see resilience/reshard.py), readable by tooling
@@ -673,6 +696,14 @@ def is_preempt_tag(save_dir, tag):
     records why the run stopped."""
     manifest = load_manifest(os.path.join(save_dir, str(tag)))
     return bool(manifest.get("preempt")) if manifest else False
+
+
+def is_suspect_tag(save_dir, tag):
+    """True for tags committed inside an unresolved integrity-anomaly
+    window (manifest ``integrity_clean: false``).  The payload verifies;
+    the NUMBERS are suspect — auto-resume prefers any clean tag."""
+    return _suspect_from_manifest(
+        tag, load_manifest(os.path.join(save_dir, str(tag))))
 
 
 def is_emergency_tag(save_dir, tag):
@@ -694,14 +725,18 @@ def resume_candidates(save_dir):
     Tags whose manifest carries ``emergency: true`` (the watchdog's
     final pre-abort snapshot — possibly of a diverged state) sort after
     every normal tag: a restart prefers the last healthy checkpoint and
-    only falls back to an emergency tag when nothing else is intact."""
+    only falls back to an emergency tag when nothing else is intact.
+    Tags stamped ``integrity_clean: false`` (committed inside an
+    unresolved silent-corruption anomaly window, ISSUE 13) sort after
+    every clean tag for the same reason — the bytes verify, the numbers
+    are suspect."""
     entries = _list_tag_entries(save_dir)
     latest = read_latest(save_dir)
     if latest is not None and latest not in [n for n, _m in entries]:
         entries.append((latest,
                         load_manifest(os.path.join(save_dir, latest))))
     return [name for name, _manifest in
-            sorted(entries, key=lambda e: _emergency_from_manifest(*e))]
+            sorted(entries, key=lambda e: _resume_rank(*e))]
 
 
 def select_resume_tag(save_dir, check_checksums=True):

@@ -33,7 +33,8 @@ class ChaosPlan:
                  slow_replica=0, slow_replica_step_s=0.05,
                  kill_ranks=(), fail_step_transient=0,
                  fail_step_transient_count=1, silence_heartbeat=None,
-                 kill_once_at_point=None):
+                 kill_once_at_point=None, flip_bits=(),
+                 spike_loss_at_step=0, spike_loss_magnitude=64.0):
         self.kill_after_files = kill_after_files
         self.kill_at_point = kill_at_point
         self.kill_once_at_point = kill_once_at_point
@@ -58,6 +59,12 @@ class ChaosPlan:
         self.slow_replica_step_every = slow_replica_step_every
         self.slow_replica = slow_replica
         self.slow_replica_step_s = slow_replica_step_s
+        # silent-corruption injectors (ISSUE 13): pending single-bit
+        # flips as (target, rank, step, leaf, element, bit) tuples, and
+        # the one-shot loss-spike window
+        self.flip_bits = [tuple(f) for f in (flip_bits or ())]
+        self.spike_loss_at_step = spike_loss_at_step
+        self.spike_loss_magnitude = spike_loss_magnitude
         self.files_written = 0
         self.fired = []
         self._lock = threading.Lock()
@@ -137,6 +144,17 @@ def arm(**kwargs):
                          'before_rollback_load' / 'before_restart_load')
                          while letting the supervisor's bounded retry
                          of that recovery then succeed.
+    flip_bits=((target, rank, step, leaf, element, bit), ...)  pending
+                         silent single-bit flips (usually armed via the
+                         flip_bit()/corrupt_opt_state() helpers): flip
+                         one bit of one element of one state leaf on ONE
+                         dp rank's replica at a step boundary — finite-
+                         but-wrong numbers the integrity sentinels and
+                         cross-replica vote must catch (ISSUE 13).
+    spike_loss_at_step=N, spike_loss_magnitude=M  one-shot PaLM-style
+                         loss spike: the batch feeding step N is scaled
+                         by M (anomalous data, symmetric across ranks —
+                         rollback-and-skip territory, not quarantine).
     """
     global _plan
     _plan = ChaosPlan(**kwargs)
@@ -444,6 +462,102 @@ def consume_nan_grad_step():
     _plan.nan_grad_steps -= 1
     _plan.fired.append(("nan_grads", _plan.nan_grad_steps))
     return True
+
+
+def flip_bit(rank, step, leaf=0, element=0, bit=30, target="params"):
+    """Arm a SINGLE-BIT flip in dp rank ``rank``'s replica of one state
+    leaf, applied at the step-``step`` boundary (after that step's
+    optimizer update commits) — the silent-data-corruption injector of
+    ISSUE 13.  The flipped replica stays finite, so nothing in the
+    NaN/overflow machinery fires: only the integrity sentinels (z-score
+    on loss/grad-norm/update-ratio) and the cross-replica checksum vote
+    can see it.  ``leaf`` indexes ``state.params`` (or ``state.
+    opt_state`` with ``target="opt"``) in flatten order; ``element`` is
+    the flat element, ``bit`` the fp32 word bit (default 30, the top
+    exponent bit — clear on any weight with |w| < 1, so the flip
+    inflates it by ~2^124: loud but finite).  Composes with an already-armed plan, or
+    arms a fresh one."""
+    plan = _plan if _plan is not None else arm()
+    with plan._lock:
+        plan.flip_bits.append((str(target), int(rank), int(step),
+                               int(leaf), int(element), int(bit)))
+    return plan
+
+
+def corrupt_opt_state(rank, step, leaf=0, element=0, bit=30):
+    """Arm a single-bit flip in one OPTIMIZER-STATE leaf on dp rank
+    ``rank`` (applied at the step-``step`` boundary).  Physics note:
+    under ZeRO sharding the optimizer shard has no replica — the
+    corruption propagates symmetrically through the parameter exchange,
+    so it is caught by the sentinels (and rolled back), not attributed
+    to a rank by the vote.  That asymmetry is exactly what the e2e
+    tests pin."""
+    return flip_bit(rank, step, leaf=leaf, element=element, bit=bit,
+                    target="opt")
+
+
+def spike_loss(step, magnitude=64.0):
+    """Arm a one-shot PaLM-style loss spike: the batch that feeds
+    optimizer step ``step`` has its float features scaled by
+    ``magnitude`` (anomalous DATA, not a rank fault) — losses and
+    gradients spike finite-but-wrong on EVERY rank, the cross-replica
+    vote stays unanimous, and the correct response is rollback plus
+    skipping the offending data window."""
+    plan = _plan if _plan is not None else arm()
+    plan.spike_loss_at_step = int(step)
+    plan.spike_loss_magnitude = float(magnitude)
+    return plan
+
+
+def consume_bit_flips(step_index):
+    """Pending bit flips due at/before this completed optimizer step, as
+    ``(target, rank, leaf, element, bit)`` tuples; each fires once."""
+    if _plan is None or not _plan.flip_bits:
+        return []
+    due = []
+    with _plan._lock:
+        rest = []
+        for target, rank, step, leaf, element, bit in _plan.flip_bits:
+            if step_index >= step:
+                due.append((target, rank, leaf, element, bit))
+                _plan.fired.append(("flip_bit",
+                                    (target, rank, step, leaf, element,
+                                     bit)))
+            else:
+                rest.append((target, rank, step, leaf, element, bit))
+        _plan.flip_bits = rest
+    for f in due:
+        _notify("flip_bit", f)
+    return due
+
+
+def maybe_spike_batch(batch, next_step):
+    """Scale the batch feeding optimizer step ``next_step`` when a
+    ``spike_loss`` plan is armed for it (one-shot).  Host-side, float
+    arrays only — integer ids/labels pass through untouched."""
+    if _plan is None or not _plan.spike_loss_at_step \
+            or next_step != _plan.spike_loss_at_step:
+        return batch
+    with _plan._lock:
+        if any(kind == "spike_loss" for kind, _ in _plan.fired):
+            return batch
+        _plan.fired.append(("spike_loss", next_step))
+    _notify("spike_loss", next_step)
+    import numpy as np
+
+    mag = _plan.spike_loss_magnitude
+
+    def scale(x):
+        a = np.asarray(x)
+        if np.issubdtype(a.dtype, np.floating):
+            return a * a.dtype.type(mag)
+        return x
+
+    logger.warning(f"chaos: spiked the batch feeding step {next_step} "
+                   f"by x{mag:g} (finite anomalous data)")
+    if isinstance(batch, dict):
+        return {k: scale(v) for k, v in batch.items()}
+    return scale(batch)
 
 
 def corrupt_file(path, offset=0, nbytes=4):

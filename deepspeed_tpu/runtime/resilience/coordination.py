@@ -75,6 +75,27 @@ def min_int(value):
     return int(np.min(vals))
 
 
+def gather_ints(arr):
+    """Allgather an integer ndarray across host processes; returns the
+    stacked ``[process_count, *arr.shape]`` table.
+
+    The integrity vote's agreement primitive (ISSUE 13): every process
+    folds its addressable replicas' checksums on device, then ALL
+    processes enter this gather together — the all_agree discipline, so
+    a corrupted rank can lose the vote without any host wedging a peer
+    in a barrier.  Single process: ``arr[None]`` with no collective.
+    """
+    import jax
+    import numpy as np
+
+    arr = np.ascontiguousarray(np.asarray(arr, dtype=np.int64))
+    if jax.process_count() == 1:
+        return arr[None]
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr))
+
+
 def broadcast_tag(name):
     """Broadcast a tag name (or None) from process 0 to every host.
 

@@ -118,16 +118,25 @@ def topology_manifest(engine):
 def data_position(engine):
     """Exact position in the global sample stream: enough to fast-forward
     ANY loader shape (the offset is in samples, not batches, so a resumed
-    run with a different micro-batch/dp split lands on the same sample)."""
+    run with a different micro-batch/dp split lands on the same sample).
+
+    ``samples_skipped`` (ISSUE 13) biases the stream position past data
+    windows the integrity ladder deliberately skipped (PaLM-style
+    rollback-and-skip): the stream stands ``micro_steps`` worth of
+    TRAINED samples plus every skipped sample past its start, and both
+    numbers persist with the checkpoint so later rollbacks/resumes land
+    on the true stream offset, not the trained-sample count."""
     mb = int(engine.train_micro_batch_size_per_gpu())
     dp = int(engine.dp_world_size)
     micro_steps = int(engine.micro_steps)
+    skipped = int(getattr(engine, "samples_skipped", 0))
     return {
         "global_steps": int(engine.global_steps),
         "micro_steps": micro_steps,
         "micro_batch_per_gpu": mb,
         "dp_world_size": dp,
-        "samples_consumed": micro_steps * mb * dp,
+        "samples_skipped": skipped,
+        "samples_consumed": micro_steps * mb * dp + skipped,
     }
 
 

@@ -26,13 +26,21 @@ them into the automatic detect -> verdict -> recover loop:
   (``retry_backoff_steps`` x (strike - 1) — first retry immediate,
   ``max_transient_retries`` strikes escalate); persistent faults (watchdog NaN/overflow streaks, step
   crashes, exhausted retries) trigger a coordinated ROLLBACK to the
-  last committed tag; lost capacity (dead verdict) triggers an ELASTIC
+  last committed tag; SILENT faults — finite-but-wrong numbers caught
+  by the integrity sentinels / cross-replica vote
+  (runtime/resilience/integrity.py, ISSUE 13) — take the ``corrupt``
+  rung between them: rollback to the last integrity-CLEAN published
+  tag PLUS a PaLM-style skip of the offending data window, escalating
+  to rank QUARANTINE (elastic restart without the convicted rank) on
+  repeat offenders; lost capacity (dead verdict) triggers an ELASTIC
   RESTART onto the surviving mesh — new engine from ``engine_factory``
   at the largest valid elastic world, ``load_checkpoint(elastic=True)``
   from the last committed tag, ``fast_forward`` to the exact sample
   offset.  Zero samples are lost or replayed in the committed
   trajectory, and post-recovery losses are bit-identical to an
-  uninterrupted run on the target mesh resumed from that tag.
+  uninterrupted run on the target mesh resumed from that tag (for a
+  corrupt verdict: to an uninterrupted run that skipped the same
+  window).
 - **Accounting** — a ``recovery`` telemetry lane (failure / verdict /
   rollback / restart instants + downtime spans), MTTR and
   goodput-samples-per-wall-step in ``engine.telemetry_report()
@@ -66,11 +74,19 @@ KIND_TRANSIENT = "transient"       # step fault, live state intact
 KIND_WATCHDOG = "watchdog"         # NaN/overflow streak / stall escalation
 KIND_CRASH = "crash"               # exception/interrupt escaping a step
 KIND_PEER_STALL = "peer_stall"     # peer silent, within heartbeat window
+KIND_CORRUPT = "corrupt"           # silent-corruption verdict (ISSUE 13):
+#                                    finite-but-wrong numbers caught by the
+#                                    integrity sentinels / cross-replica
+#                                    vote — between transient and dead
 KIND_HOST_LOST = "host_lost"       # coordinated dead verdict
 
 # recovery actions (the ladder rungs)
 RECOVERY_RETRY = "retry-in-place"
 RECOVERY_ROLLBACK = "rollback"
+RECOVERY_ROLLBACK_SKIP = "rollback-and-skip"   # + skip the anomalous data
+#                                                window (PaLM-style)
+RECOVERY_QUARANTINE = "quarantine"             # elastic restart WITHOUT the
+#                                                repeat-offender rank
 RECOVERY_RESTART = "elastic-restart"
 
 
@@ -168,6 +184,16 @@ class TrainingSupervisor:
         self._backoff_until = 0
         self.last_committed_tag = None
         self._last_committed_step = -1
+        self._last_saved_step = -1
+        # numerical integrity (ISSUE 13): the corrupt rung's bookkeeping
+        self.last_clean_tag = None      # last PUBLISHED integrity-clean tag
+        self.corrupt_verdicts = 0
+        self.quarantines = 0
+        self.skipped_samples = 0        # data deliberately skipped, total
+        self._offenses = {}             # rank -> corrupt-verdict count
+        # async commit cadence (ROADMAP PR-12 follow-up): the tag whose
+        # seal is in flight — a rollback target only once PUBLISHED
+        self._pending_published = None
         self.loss_history = []      # (global_step, loss) committed; device
         #                             values until _materialize_history
         self._history_floats = 0    # prefix already folded to floats
@@ -204,9 +230,11 @@ class TrainingSupervisor:
         if self._tracer is not None:
             self._lane_recovery = self._tracer.lane("recovery")
             for name in ("failure", "retry", "dead_verdict", "rollback",
-                         "elastic_restart", "recovered", "commit_failed"):
+                         "elastic_restart", "recovered", "commit_failed",
+                         "corrupt_verdict", "quarantine"):
                 self._tracer.intern(name, args=("wall_step",))
             self._tracer.intern("downtime", args=("wall_steps",))
+            self._tracer.intern("data_skipped", args=("samples",))
 
     @staticmethod
     def _elastic_worlds(engine):
@@ -301,6 +329,14 @@ class TrainingSupervisor:
             self._on_step_fault(e, KIND_CRASH)
             return
         self._strikes = 0
+        # the corrupt rung (ISSUE 13) decides BEFORE the step commits:
+        # a verdict at this boundary discards the step's result (loss
+        # never enters the committed trajectory, the cadence commit
+        # never runs) — otherwise a corruption landing at a commit
+        # boundary could be snapshotted into a tag stamped clean and
+        # become the very rollback target the recovery flees to
+        if self._integrity_tick():
+            return
         self._note_committed(loss)
 
     # ------------------------------------------------------------------
@@ -374,22 +410,171 @@ class TrainingSupervisor:
             return
         self._rollback(reason=kind)
 
-    def _rollback(self, reason):
-        """Coordinated rollback to the last committed tag: every rank
-        agrees to enter recovery, the tag is re-broadcast (ranks must
-        not roll back to different tags), and the load + exact-sample
-        data reseat is retried through kill-mid-rollback chaos up to
-        ``max_recovery_attempts``."""
+    def _integrity_tick(self):
+        """The corrupt rung's decision point, at every healthy step
+        boundary BEFORE that step commits: the integrity monitor folds
+        sentinel + vote evidence into at most one verdict per incident
+        (integrity.IntegrityMonitor.decide — cheap early-outs; device
+        work only on the vote/dup cadences), and a verdict picks its
+        recovery — quarantine for a repeat-offender rank,
+        rollback-and-skip otherwise.  Returns True when a verdict fired
+        (the caller then discards the step's commit)."""
+        mon = getattr(self.engine, "_integrity", None)
+        if mon is None:
+            return False
+        verdict = mon.decide(self.engine, self.wall_step)
+        if verdict is None:
+            return False
+        self._on_corrupt(mon, verdict)
+        return True
+
+    def _on_corrupt(self, mon, verdict):
+        w = self.wall_step
+        self.corrupt_verdicts += 1
+        self._open(KIND_CORRUPT, w)
+        inc = self._open_incident
+        culprits = list(verdict.get("culprits") or [])
+        for r in culprits:
+            self._offenses[r] = self._offenses.get(r, 0) + 1
+        if inc is not None:
+            inc.update({
+                "kind": KIND_CORRUPT, "culprits": sorted(culprits),
+                "source": verdict.get("source"),
+                "tie": bool(verdict.get("tie")),
+                "anomaly_step": verdict.get("anomaly_step"),
+                "detection_latency_steps": verdict.get("latency_steps"),
+                "offense_counts": dict(self._offenses),
+            })
+        self._instant("corrupt_verdict", a0=w)
+        log_dist(
+            f"supervisor: CORRUPT verdict at wall step {w} "
+            f"(source={verdict.get('source')}, "
+            f"culprits={sorted(culprits) or 'none'}, "
+            f"tie={bool(verdict.get('tie'))}, detection latency "
+            f"{verdict.get('latency_steps')} step(s))",
+            ranks=[0], level=logging.WARNING)
+        # repeat offenders get quarantined: the rank keeps producing
+        # corrupt replicas, so rolling back onto it again is wasted
+        # goodput — restart elastically WITHOUT it.  Host 0 is the local
+        # process (not quarantinable in the single-process sim), and the
+        # rung needs elasticity + restart budget; otherwise fall through
+        # to rollback-and-skip (a tie never counts an offense: the vote
+        # refused a rank verdict)
+        repeat = sorted(
+            r for r in culprits
+            if r != 0 and self._offenses.get(r, 0)
+            >= mon.config.quarantine_after)
+        try:
+            if repeat and self._elastic is not None \
+                    and self.restarts < self.config.max_restarts:
+                self.quarantines += 1
+                if inc is not None:
+                    inc["quarantined"] = repeat
+                log_dist(
+                    f"supervisor: QUARANTINING repeat-offender rank(s) "
+                    f"{repeat} ({self._offenses}) — elastic restart "
+                    f"without them", ranks=[0], level=logging.WARNING)
+                self._elastic_restart(repeat, reason=KIND_CORRUPT)
+            else:
+                self._rollback(reason=KIND_CORRUPT, skip_data=True)
+        finally:
+            # re-arm the monitor whatever the recovery did (even a
+            # SupervisorGaveUp must not wedge a later operator-driven
+            # resume behind a latched verdict)
+            mon.resolve(recovered=True)
+
+    def _drain_pending_commit(self):
+        """Async-cadence satellite (ROADMAP PR-12 follow-up): before any
+        verdict-driven recovery, drain the pending seal — a sealed-but-
+        unpublished tag either publishes here (becoming the freshest
+        rollback target via on_commit_published) or fails here (the
+        previous PUBLISHED tag stays the target; counted like any
+        commit failure, never fatal)."""
+        eng = self.engine
+        if not callable(getattr(eng, "pending_commit", None)) \
+                or not eng.pending_commit():
+            return
+        try:
+            eng.wait_pending_commit()
+        except Exception as e:  # lint: allow-broad-except — a failed
+            # seal/publish must not abort the recovery already running;
+            # the rollback target stays the last published tag
+            self.commit_failures += 1
+            self._pending_published = None
+            logger.warning(
+                f"supervisor: pending async commit failed while draining "
+                f"before recovery ({type(e).__name__}: {e}) — rollback "
+                f"target stays {self.last_committed_tag!r}")
+            self._instant("commit_failed", a0=self.wall_step)
+
+    def _skip_and_reseat(self, pos_before):
+        """Rollback-and-skip (PaLM-style): the engine is freshly rolled
+        back to a clean tag; advance the DATA stream past everything
+        consumed up to the fault, so the anomalous window is never
+        trained on again.  The skip is loud (incident ledger + warning
+        + ``data_skipped`` instant) and persists in every later
+        checkpoint via ``engine.samples_skipped`` — honest goodput
+        accounting, not silent sample loss."""
+        from deepspeed_tpu.runtime.resilience.reshard import (data_position,
+                                                              fast_forward)
+
+        gs = int(self.engine.global_steps)
+        self.loss_history = [(g, l) for g, l in self.loss_history
+                             if g <= gs]
+        self._history_floats = min(self._history_floats,
+                                   len(self.loss_history))
+        at_tag = int(data_position(self.engine)["samples_consumed"])
+        skip = int(pos_before["samples_consumed"]) - at_tag
+        if skip > 0:
+            self.engine.samples_skipped += skip
+            self.skipped_samples += skip
+            inc = self._open_incident
+            if inc is not None:
+                inc["skipped_samples"] = skip
+                inc["skip_from_sample"] = at_tag
+                inc["skip_to_sample"] = at_tag + skip
+            self._instant("data_skipped", a0=skip)
+            log_dist(
+                f"supervisor: SKIPPING the anomalous data window — "
+                f"samples [{at_tag}, {at_tag + skip}) ({skip} samples) "
+                f"will never be trained on (PaLM-style rollback-and-"
+                f"skip; recorded in the incident ledger and in every "
+                f"later checkpoint's data_position)",
+                ranks=[0], level=logging.WARNING)
+        it = self.data_factory(self.engine)
+        self.data_iter = fast_forward(it, data_position(self.engine),
+                                      self.engine)
+
+    def _rollback(self, reason, skip_data=False):
+        """Coordinated rollback: every rank agrees to enter recovery,
+        the tag is re-broadcast (ranks must not roll back to different
+        tags), and the load + exact-sample data reseat is retried
+        through kill-mid-rollback chaos up to ``max_recovery_attempts``.
+        A ``corrupt`` verdict targets the last integrity-CLEAN published
+        tag (a suspect tag holds the corruption it is fleeing) and skips
+        the anomalous data window; every other reason targets the last
+        published tag and replays."""
+        self._drain_pending_commit()
         all_agree(True)     # recovery barrier: enter together or not at all
-        tag = broadcast_tag(self.last_committed_tag)
+        from deepspeed_tpu.runtime.resilience.reshard import data_position
+
+        pos_before = data_position(self.engine)
+        corrupt = reason == KIND_CORRUPT
+        tag = broadcast_tag(self.last_clean_tag if corrupt
+                            else self.last_committed_tag)
         if tag is None:
             raise SupervisorGaveUp(
-                f"persistent {reason} fault with NO committed tag to roll "
-                f"back to — commit cadence (checkpoint_every_steps) never "
-                f"fired before the first failure")
+                f"persistent {reason} fault with NO "
+                f"{'integrity-clean ' if corrupt else ''}committed tag to "
+                f"roll back to — "
+                + ("every committed tag was stamped inside the anomaly "
+                   "window" if corrupt and self.last_committed_tag
+                   else "commit cadence (checkpoint_every_steps) never "
+                        "fired before the first failure"))
         inc = self._open_incident
         if inc is not None:
-            inc["recovery"] = RECOVERY_ROLLBACK
+            inc["recovery"] = RECOVERY_ROLLBACK_SKIP if skip_data \
+                else RECOVERY_ROLLBACK
             inc["tag"] = tag
         last_err = None
         for _attempt in range(self.config.max_recovery_attempts):
@@ -397,7 +582,10 @@ class TrainingSupervisor:
                 chaos.point("before_rollback_load")
                 _path, client = self.engine.load_checkpoint(
                     self.save_dir, tag=tag, elastic=True)
-                self._reseat_data(client)
+                if skip_data:
+                    self._skip_and_reseat(pos_before)
+                else:
+                    self._reseat_data(client)
                 break
             except chaos.ChaosInterrupt as e:
                 # a kill landing mid-rollback: the committed tag on disk
@@ -414,19 +602,44 @@ class TrainingSupervisor:
         self.rollbacks += 1
         self._strikes = 0
         self._backoff_until = 0
+        if skip_data:
+            self._rebase_commit_tracking(tag)
         self._instant("rollback", a0=self.wall_step)
         log_dist(f"supervisor: rolled back to committed tag {tag!r} "
-                 f"({reason}) at wall step {self.wall_step}", ranks=[0],
+                 f"({reason}{', data window skipped' if skip_data else ''}"
+                 f") at wall step {self.wall_step}", ranks=[0],
                  level=logging.WARNING)
 
-    def _elastic_restart(self, dead):
-        """Lost capacity: restart onto the surviving mesh.  The new
-        world is the largest valid elastic world that fits the
-        survivors, agreed fleet-wide (``min_int``); the new engine loads
-        the last committed tag elastically and the data stream is
-        fast-forwarded to the exact committed sample offset."""
+    def _rebase_commit_tracking(self, tag):
+        """After a rollback-AND-SKIP the replayed steps train on
+        DIFFERENT data (the window moved), so tags committed past the
+        landing tag are stale — rebase the cadence so the replay
+        re-commits them (the atomic tag-overwrite path makes that safe),
+        and never leave a stale suspect tag as the rollback target."""
+        gs = int(self.engine.global_steps)
+        self.last_committed_tag = tag
+        self.last_clean_tag = tag
+        self._last_committed_step = gs
+        self._last_saved_step = gs
+        self._pending_published = None
+
+    def _elastic_restart(self, dead, reason=KIND_HOST_LOST):
+        """Lost (or quarantined) capacity: restart onto the surviving
+        mesh.  The new world is the largest valid elastic world that
+        fits the survivors, agreed fleet-wide (``min_int``); the new
+        engine loads elastically and the data stream is fast-forwarded
+        to the exact committed sample offset.  ``reason=KIND_CORRUPT``
+        is the QUARANTINE rung: the dead list is a repeat-offender rank
+        the integrity vote convicted — the restart loads the last
+        integrity-CLEAN tag and skips the anomalous data window, same
+        as rollback-and-skip."""
         w = self.wall_step
-        self._open(KIND_HOST_LOST, w)
+        corrupt = reason == KIND_CORRUPT
+        self._drain_pending_commit()
+        from deepspeed_tpu.runtime.resilience.reshard import data_position
+
+        pos_before = data_position(self.engine)
+        self._open(reason, w)
         inc = self._open_incident
         for h in self.hosts:
             if h.rank in dead:
@@ -434,9 +647,10 @@ class TrainingSupervisor:
         survivors = [h for h in self.hosts if h.alive]
         if self._elastic is None:
             raise SupervisorGaveUp(
-                f"rank(s) {sorted(dead)} lost but elastic restart is "
-                f"DISARMED (no elasticity config) — cannot reshard onto "
-                f"{len(survivors)} survivors")
+                f"rank(s) {sorted(dead)} "
+                f"{'quarantined' if corrupt else 'lost'} but elastic "
+                f"restart is DISARMED (no elasticity config) — cannot "
+                f"reshard onto {len(survivors)} survivors")
         if self.restarts >= self.config.max_restarts:
             raise SupervisorGaveUp(
                 f"rank(s) {sorted(dead)} lost after {self.restarts} elastic "
@@ -448,13 +662,18 @@ class TrainingSupervisor:
                 f"no valid elastic world fits {len(survivors)} surviving "
                 f"host(s) (valid: {valid})")
         new_world = min_int(max(fits))
-        tag = broadcast_tag(self.last_committed_tag)
+        tag = broadcast_tag(self.last_clean_tag if corrupt
+                            else self.last_committed_tag)
         if tag is None:
             raise SupervisorGaveUp(
-                f"rank(s) {sorted(dead)} lost before any committed tag — "
+                f"rank(s) {sorted(dead)} "
+                f"{'quarantined' if corrupt else 'lost'} before any "
+                f"{'integrity-clean ' if corrupt else ''}committed tag — "
                 f"nothing to restart from")
         if inc is not None:
-            inc.update({"kind": KIND_HOST_LOST, "recovery": RECOVERY_RESTART,
+            inc.update({"kind": reason,
+                        "recovery": RECOVERY_QUARANTINE if corrupt
+                        else RECOVERY_RESTART,
                         "dead": sorted(dead), "tag": tag,
                         "world_from": self.world, "world_to": new_world,
                         "verdict_step": w})
@@ -485,7 +704,12 @@ class TrainingSupervisor:
         # engine's lane dies with it, and the survivor's exported trace
         # must narrate the incident that created it (a0 = verdict step)
         self._instant("elastic_restart", a0=w)
-        self._reseat_data(client)
+        if corrupt:
+            self._instant("quarantine", a0=w)
+            self._skip_and_reseat(pos_before)
+            self._rebase_commit_tracking(tag)
+        else:
+            self._reseat_data(client)
         old.close_telemetry()       # release chaos observers/streams; the
         # dead-world engine is dropped for GC — its devices are "gone"
         self.hosts = survivors[:new_world]
@@ -493,6 +717,12 @@ class TrainingSupervisor:
         self.restarts += 1
         self._strikes = 0
         self._backoff_until = 0
+        # dp rank indices RENUMBER on the shrunken world: an offense
+        # count keyed by the old index would pre-load whichever host
+        # inherits it toward quarantine — the ledger keeps the history
+        # (incidents record offense_counts at verdict time), the live
+        # counter starts over
+        self._offenses = {}
         log_dist(
             f"supervisor: elastic restart complete — world "
             f"{inc['world_from'] if inc else '?'} -> {new_world}, resumed "
@@ -561,13 +791,18 @@ class TrainingSupervisor:
     def _maybe_commit(self, gs):
         every = self.config.checkpoint_every_steps
         if not self.armed or every <= 0 or gs % every \
-                or gs <= self._last_committed_step:
+                or gs <= self._last_saved_step:
             return
-        # synchronous commit: a committed tag must be durable BEFORE it
-        # becomes the rollback target (an async seal still in flight is
-        # not a tag the ladder can land on)
+        # commit cadence follows the engine's resilience.async_commit
+        # config (ROADMAP PR-12 follow-up, lifted restriction): a SYNC
+        # commit is a rollback target the moment save returns; an ASYNC
+        # one only once its foreground publish lands (on_commit_published
+        # — the supervisor tracks only PUBLISHED tags, and recoveries
+        # drain the pending seal first)
+        mon = getattr(self.engine, "_integrity", None)
+        clean = bool(mon.clean()) if mon is not None else True
         try:
-            self.engine.save_checkpoint(self.save_dir, async_commit=False)
+            self.engine.save_checkpoint(self.save_dir)
         except Exception as e:  # lint: allow-broad-except — a failed
             # commit (disk full, kill mid-write) must not kill the run
             # the supervisor exists to keep alive: the atomic writer
@@ -583,8 +818,63 @@ class TrainingSupervisor:
                 f"({self.commit_failures} commit failure(s) so far)")
             self._instant("commit_failed", a0=self.wall_step)
             return
-        self.last_committed_tag = f"global_step{gs}"
-        self._last_committed_step = gs
+        self._last_saved_step = gs
+        tag = f"global_step{gs}"
+        if callable(getattr(self.engine, "pending_commit", None)) \
+                and self.engine.pending_commit():
+            # async seal in flight: NOT a rollback target yet
+            self._pending_published = {"tag": tag, "global_steps": gs,
+                                       "integrity_clean": clean}
+            return
+        self._record_published(tag, gs, clean)
+
+    def _record_published(self, tag, gs, clean):
+        """A tag became durable-visible (sync save returned, or an async
+        publish landed): it is now a rollback target; integrity-clean
+        tags additionally become the corrupt rung's target."""
+        self.last_committed_tag = tag
+        self._last_committed_step = max(self._last_committed_step, int(gs))
+        if clean:
+            self.last_clean_tag = tag
+        self._pending_published = None
+
+    def on_commit_failed(self, exc):
+        """Engine hook: an ASYNC commit's seal or publish failed at a
+        step boundary.  Same contract as a failed synchronous commit —
+        count it, keep the previous PUBLISHED tag as the rollback
+        target, never kill (or roll back) the run over an IO failure."""
+        self.commit_failures += 1
+        pending = self._pending_published
+        self._pending_published = None
+        logger.warning(
+            f"supervisor: async checkpoint commit"
+            f"{' of ' + repr(pending['tag']) if pending else ''} failed at "
+            f"the step boundary ({type(exc).__name__}: {exc}) — training "
+            f"continues, rollback target stays "
+            f"{self.last_committed_tag!r} ({self.commit_failures} commit "
+            f"failure(s) so far)")
+        self._instant("commit_failed", a0=self.wall_step)
+
+    def on_commit_published(self, info):
+        """Engine hook: an ASYNC checkpoint commit finished its
+        foreground publish (rename + latest).  Only now does the tag
+        become a rollback target — and its integrity stamp is the one
+        fixed at COMMIT time (a window that opened after the snapshot
+        does not taint it, and one that closed since does not clean
+        it)."""
+        tag = info.get("tag")
+        gs = info.get("global_steps")
+        if tag is None or gs is None:
+            return
+        if info.get("save_dir") != self.save_dir:
+            # a user-driven save to some OTHER directory (an export, a
+            # side snapshot) is not a recovery target: _rollback only
+            # ever loads from self.save_dir, so recording this tag
+            # would point the ladder at a tag that does not exist there
+            return
+        if int(gs) >= self._last_committed_step:
+            self._record_published(str(tag), int(gs),
+                                   bool(info.get("integrity_clean", True)))
 
     def _open(self, kind, w):
         """Open (or escalate) the current incident; instants + the
@@ -661,6 +951,14 @@ class TrainingSupervisor:
             "committed_steps": gs,
             "committed_samples": gs * batch,
             "goodput_samples_per_wall_step": gs * batch / wall,
+            # numerical integrity (ISSUE 13): skipped data is an honest
+            # goodput cost — those samples were consumed from the stream
+            # but never trained on, and the ledger says so
+            "corrupt_verdicts": self.corrupt_verdicts,
+            "quarantines": self.quarantines,
+            "skipped_samples": self.skipped_samples,
+            "offense_counts": dict(self._offenses),
+            "last_clean_tag": self.last_clean_tag,
             "mttr_steps": {
                 "mean": sum(mttrs) / len(mttrs) if mttrs else None,
                 "max": max(mttrs) if mttrs else None,

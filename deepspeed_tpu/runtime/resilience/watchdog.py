@@ -23,6 +23,7 @@ from deepspeed_tpu.utils.logging import logger
 EVENT_OVERFLOW_STREAK = "overflow_streak"
 EVENT_NAN_LOSS = "nan_loss"
 EVENT_STALL = "stall"
+EVENT_INTEGRITY = "silent_corruption"
 
 ACTION_ABORT = "abort"
 ACTION_CONTINUE = "continue"
@@ -217,6 +218,26 @@ class TrainingWatchdog:
         self.last_progress_time = now
         self._dispatch(fired)
         return fired
+
+    def observe_integrity(self, step, verdict):
+        """Feed a confirmed silent-corruption verdict from the integrity
+        monitor (runtime/resilience/integrity.py) — the UNSUPERVISED
+        escalation path: without a TrainingSupervisor there is no
+        rollback ladder, so a corrupt verdict becomes a watchdog event
+        with the usual abort/continue dispatch (abort still writes the
+        engine's emergency checkpoint first — stamped integrity-suspect
+        by the open anomaly window, so auto-resume prefers an older
+        clean tag).  Supervised engines never call this: the supervisor
+        owns the corrupt rung."""
+        event = WatchdogEvent(
+            EVENT_INTEGRITY, step,
+            f"silent-corruption verdict at step {step} via "
+            f"{verdict.get('source')}: "
+            + (f"minority rank(s) {verdict.get('culprits')}"
+               if verdict.get("culprits") else "no culprit (symmetric)"),
+            dict(verdict))
+        self._dispatch([event])
+        return event
 
     def check_stall(self, step):
         """Poll for a stall without observing a step (e.g. from a monitor

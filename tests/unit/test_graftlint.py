@@ -664,6 +664,68 @@ def test_host_sync_quiet_on_supervisor_host_only_loop():
                 rules=["host-sync"]) == []
 
 
+HS_INTEGRITY_VOTE_BAD = """
+class IntegrityMonitor:
+    def state_vote(self, engine):
+        digests = []
+        for leaf in jax.tree_util.tree_leaves(engine.state.params):
+            digests.append(int(jax.device_get(fold(leaf))))
+        return digests
+"""
+
+HS_INTEGRITY_OBSERVE_BAD = """
+class IntegrityMonitor:
+    def observe_step(self, step, metrics):
+        zs = {}
+        for name, value in metrics.items():
+            zs[name] = self.stats[name].z(float(jax.device_get(value)))
+        return zs
+"""
+
+HS_INTEGRITY_GOOD = """
+def state_vote(engine):
+    with jax.set_mesh(engine.mesh):
+        table = engine._integrity._vote_jit(tuple(leaves))
+    rows = np.asarray(jax.device_get(table), dtype=np.int64)
+    return classify_digests(rows)
+
+
+class IntegrityMonitor:
+    def observe_step(self, step, loss=None, grad_norm=None,
+                     update_ratio=None, overflow=False):
+        samples = {"loss": loss, "grad_norm": grad_norm,
+                   "update_ratio": update_ratio}
+        zs = {}
+        for n, v in samples.items():
+            if v is not None:
+                zs[n] = self.stats[n].z(v)
+        return any(z > self.config.z_threshold for z in zs.values())
+"""
+
+
+@pytest.mark.parametrize("src,label", [
+    (HS_INTEGRITY_VOTE_BAD, "per-leaf digest fetch"),
+    (HS_INTEGRITY_OBSERVE_BAD, "per-sentinel device fetch"),
+])
+def test_host_sync_covers_integrity_hot_fns(src, label):
+    """ISSUE 13 satellite: the integrity monitor's per-step observe and
+    the vote entry points are hot — the sentinel values must RIDE the
+    engine's one batched fetch, and a vote may fetch its digest table
+    exactly once (straight-line); a per-leaf/per-sentinel device_get
+    loop serializes the state against the host every step."""
+    got = lint(src, "deepspeed_tpu/runtime/resilience/integrity.py",
+               rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"], label
+
+
+def test_host_sync_quiet_on_integrity_batched_fetch():
+    # the real shape: ONE straight-line device_get of the gathered
+    # digest table per vote, pure host float math in observe_step
+    assert lint(HS_INTEGRITY_GOOD,
+                "deepspeed_tpu/runtime/resilience/integrity.py",
+                rules=["host-sync"]) == []
+
+
 def test_host_sync_quiet_on_host_only_reliability_fns():
     # the real implementations are pure host accounting: clock reads,
     # dict walks, journal appends — no findings
@@ -905,6 +967,44 @@ def test_disarmed_discipline_covers_arm_supervisor_path():
     assert rule_names(got) == ["disarmed-discipline"]
     assert "_arm_supervisor" in got[0].message
     assert lint(DISARM_SUPERVISOR_GOOD, rules=["disarmed-discipline"]) == []
+
+
+DISARM_INTEGRITY_BAD = """
+class DeepSpeedEngine:
+    def _arm_integrity(self):
+        self._integrity = None
+        if not self._resilience.integrity_enabled:
+            return
+        if self._offload or self._onebit_wire():
+            return
+        self._integrity = IntegrityMonitor(cfg, self.dp_world_size)
+"""
+
+DISARM_INTEGRITY_GOOD = """
+class DeepSpeedEngine:
+    def _arm_integrity(self):
+        self._integrity = None
+        if not self._resilience.integrity_enabled:
+            return
+        if self._offload or self._onebit_wire():
+            log_dist("numerical-integrity defense DISARMED - "
+                     "cpu_offload / 1-bit wire leave no device-resident "
+                     "replicated state to vote over", ranks=[0],
+                     level=logging.WARNING)
+            return
+        self._integrity = IntegrityMonitor(cfg, self.dp_world_size)
+"""
+
+
+def test_disarmed_discipline_covers_arm_integrity_path():
+    """ISSUE 13 satellite: the integrity arming fn is held to the
+    armed-or-warns discipline — silently skipping the defense (silent
+    corruption then sails past every detector) fires; warning DISARMED
+    naming the blockers quiets it."""
+    got = lint(DISARM_INTEGRITY_BAD, rules=["disarmed-discipline"])
+    assert rule_names(got) == ["disarmed-discipline"]
+    assert "_arm_integrity" in got[0].message
+    assert lint(DISARM_INTEGRITY_GOOD, rules=["disarmed-discipline"]) == []
 
 
 # ---------------------------------------------------------------------------

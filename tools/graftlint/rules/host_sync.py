@@ -46,6 +46,7 @@ HOT_FILES = {
     "deepspeed_tpu/serving/reliability.py",
     "deepspeed_tpu/serving/fleet.py",
     "deepspeed_tpu/runtime/resilience/supervisor.py",
+    "deepspeed_tpu/runtime/resilience/integrity.py",
 }
 HOT_FN_RE = re.compile(
     r"^(train_batch|eval_batch|forward|backward|step"
@@ -73,7 +74,15 @@ HOT_FN_RE = re.compile(
     # heartbeat/verdict tick would serialize every step against the
     # host even in the no-failure steady state)
     r"|tick|supervised_step|_heartbeat_tick|_verdict|_rollback"
-    r"|_elastic_restart|_reseat_\w+)$")
+    r"|_elastic_restart|_reseat_\w+"
+    # numerical-integrity defense (ISSUE 13): observe_step runs once per
+    # optimizer step on the supervised hot path (the sentinel values must
+    # RIDE the engine's one batched fetch, never re-sync), and the
+    # vote/dup-check entry points are allowed exactly ONE straight-line
+    # fetch per cadence hit — a per-leaf or per-rank device_get loop
+    # would serialize the whole state against the host
+    r"|observe_step|decide|note_micro|state_vote|dup_check"
+    r"|apply_chaos_faults|_integrity_tick|_skip_and_reseat)$")
 # benchmark drivers: every loop is (or brackets) a timed region — a sync
 # per iteration pollutes the measured step time with transfer latency
 BENCH_FILES = {"bench.py", "tools/pipe_bench.py", "tools/serve_bench.py"}

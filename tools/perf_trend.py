@@ -112,6 +112,13 @@ def trend_rows(rounds):
                 "mttr_steps_mean": (payload.get("mttr_steps") or {}).get(
                     "mean") if isinstance(payload.get("mttr_steps"), dict)
                     else payload.get("mttr_steps"),
+                # numerical integrity (ISSUE 13 --chaos bitflip rung):
+                # same honest-gap contract — rounds without the rung
+                # lack the keys, never a fake zero-latency detection
+                "detection_latency_steps":
+                    payload.get("detection_latency_steps"),
+                "corruption_recovered":
+                    payload.get("corruption_recovered"),
                 "trace": tel.get("trace"),
                 "metrics_jsonl": tel.get("metrics_jsonl"),
             })
@@ -159,7 +166,8 @@ def trend_payload(pattern=DEFAULT_GLOB, root=".",
         "rounds": [{k: r.get(k) for k in
                     ("round", "ok", "value", "unit", "mfu", "step_ms",
                      "tokens_per_sec", "goodput_samples_per_wall_step",
-                     "mttr_steps_mean")} for r in rows],
+                     "mttr_steps_mean", "detection_latency_steps",
+                     "corruption_recovered")} for r in rows],
         "dead_rounds": [r["round"] for r in rows if not r["ok"]],
         "regression": check_regression(rows, threshold),
     }
@@ -193,12 +201,15 @@ def main(argv=None):
         print(json.dumps(summary, indent=1))
     else:
         print(f"{'round':>5} {'ok':>3} {'value':>10} {'mfu':>7} "
-              f"{'step_ms':>9} {'tok/s':>12}  metric")
+              f"{'step_ms':>9} {'tok/s':>12} {'det.lat':>8} {'recov':>6}"
+              f"  metric")
         for r in rows:
             print(f"{r['round']:>5} {'y' if r['ok'] else 'n':>3} "
                   f"{_fmt(r.get('value')):>10} {_fmt(r.get('mfu'), 4):>7} "
                   f"{_fmt(r.get('step_ms'), 1):>9} "
-                  f"{_fmt(r.get('tokens_per_sec'), 0):>12}  "
+                  f"{_fmt(r.get('tokens_per_sec'), 0):>12} "
+                  f"{_fmt(r.get('detection_latency_steps'), 0):>8} "
+                  f"{_fmt(r.get('corruption_recovered')):>6}  "
                   f"{(r.get('metric') or '-')[:60]}")
         if verdict["baseline"]:
             word = "REGRESSED" if verdict["regressed"] else "ok"

@@ -356,6 +356,26 @@ def _run_one(args, ctx) -> int:
         telemetry_out = {"metrics_jsonl": tele_paths["metrics"],
                          "trace": trace_path, "mfu": mfu_rep}
 
+    # memory accounting (ISSUE 15): measured HBM watermark + delta vs
+    # the analytic model, once per attempt AFTER the timed region.
+    # Rounds on backends with no memory_stats (CPU) publish null —
+    # honest gaps in the perf_trend table, never fake zeros.
+    peak_hbm_bytes = analytic_peak_bytes = hbm_delta = None
+    try:
+        mrep = engine.memory_report()  # graftlint: disable=host-sync
+        analytic_peak_bytes = (mrep.get("analytic") or {}).get("peak_bytes")
+        peaks = [d.get("peak_bytes_in_use")
+                 for d in mrep.get("devices", [])]
+        peaks = [p for p in peaks if p]
+        peak_hbm_bytes = max(peaks) if peaks else None
+        if peak_hbm_bytes and analytic_peak_bytes:
+            hbm_delta = round(peak_hbm_bytes / analytic_peak_bytes - 1.0,
+                              4)
+    except Exception as e:  # lint: allow-broad-except — the memory
+        # probe must never cost the round its perf number
+        print(f"[bench] memory_report failed: {e}", file=sys.stderr,
+              flush=True)
+
     print(json.dumps({
         "metric": f"{args.model}{'-sparse' if args.sparse else ''} "
                   f"seq{args.seq} train TFLOPS/chip "
@@ -371,6 +391,9 @@ def _run_one(args, ctx) -> int:
         "platform": platform,
         "samples_per_sec": round(samples_per_sec, 2),
         "tokens_per_sec": round(tokens_per_sec, 1),
+        "peak_hbm_bytes": peak_hbm_bytes,
+        "analytic_peak_bytes": analytic_peak_bytes,
+        "hbm_delta_vs_analytic": hbm_delta,
         "step_ms": round(1000.0 / steps_per_sec, 1),
         "loss": final_loss,
         "params_m": round(n_params / 1e6, 1),

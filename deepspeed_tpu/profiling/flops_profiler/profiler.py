@@ -56,8 +56,15 @@ def duration_to_string(duration, units=None, precision=2):
 def analyze_jit(fn: Callable, *args, static_argnums=()) -> Dict[str, Any]:
     """Lower+compile fn(*args) and return XLA's cost analysis:
     {'flops': float, 'bytes_accessed': float, ...}. Costs are for the
-    optimized (fused) HLO — the program that actually runs."""
+    optimized (fused) HLO — the program that actually runs.  The memory
+    side delegates to runtime/memory_accounting.normalize_memory_analysis
+    — THE normalizer for the dict/None/per-backend memory_analysis()
+    variants (same treatment mfu.normalize_cost_analysis gives the cost
+    side)."""
     import jax
+
+    from deepspeed_tpu.runtime.memory_accounting import \
+        normalize_memory_analysis
 
     lowered = jax.jit(fn, static_argnums=static_argnums).lower(*args)
     compiled = lowered.compile()
@@ -65,11 +72,12 @@ def analyze_jit(fn: Callable, *args, static_argnums=()) -> Dict[str, Any]:
     if isinstance(cost, list):  # some backends return a list per computation
         cost = cost[0] if cost else {}
     cost = dict(cost or {})
-    mem = compiled.memory_analysis()
-    if mem is not None:
-        cost["output_bytes"] = getattr(mem, "output_size_in_bytes", None)
-        cost["temp_bytes"] = getattr(mem, "temp_size_in_bytes", None)
-        cost["argument_bytes"] = getattr(mem, "argument_size_in_bytes", None)
+    mem = normalize_memory_analysis(compiled)
+    if mem["modeled"]:
+        cost["output_bytes"] = mem["output_bytes"]
+        cost["temp_bytes"] = mem["temp_bytes"]
+        cost["argument_bytes"] = mem["argument_bytes"]
+        cost["peak_bytes"] = mem["peak_bytes"]
     return cost
 
 

@@ -479,6 +479,8 @@ def get_telemetry_config(param_dict):
         TELEMETRY_METRICS_FSYNC: bool(d.get(TELEMETRY_METRICS_FSYNC,
                                             TELEMETRY_METRICS_FSYNC_DEFAULT)),
         TELEMETRY_MFU: bool(d.get(TELEMETRY_MFU, TELEMETRY_MFU_DEFAULT)),
+        TELEMETRY_MEMORY: bool(d.get(TELEMETRY_MEMORY,
+                                     TELEMETRY_MEMORY_DEFAULT)),
         TELEMETRY_PEAK_TFLOPS: peak,
     }
 

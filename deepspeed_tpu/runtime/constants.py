@@ -276,6 +276,11 @@ TELEMETRY_METRICS_FSYNC = "metrics_fsync"       # fsync each step record
 TELEMETRY_METRICS_FSYNC_DEFAULT = False
 TELEMETRY_MFU = "mfu"                           # cost_analysis MFU channel
 TELEMETRY_MFU_DEFAULT = True
+# measured HBM accounting channel (runtime/memory_accounting.py): per-jit
+# memory_analysis() + device watermark gauges; shares the lazy compile
+# cache with the MFU channel when both are armed
+TELEMETRY_MEMORY = "memory"
+TELEMETRY_MEMORY_DEFAULT = True
 # explicit bf16 peak TFLOPS per device for MFU/HFU ratios; 0 = auto from
 # the device kind (unknown kinds — CPU meshes — report mfu=None)
 TELEMETRY_PEAK_TFLOPS = "peak_tflops_per_device"

@@ -195,6 +195,11 @@ class DeepSpeedEngine:
         # --- telemetry (ISSUE 10) -----------------------------------------
         self._arm_telemetry()
 
+        # --- memory accounting (ISSUE 15) ---------------------------------
+        # after telemetry so the measured side can share its lazy compile
+        # cache (one lower().compile() per jit serves MFU and memory)
+        self._arm_memory_accounting()
+
         # --- numerical integrity (ISSUE 13) -------------------------------
         # after telemetry so the monitor can claim its tracer lane
         self._arm_integrity()
@@ -1043,12 +1048,18 @@ class DeepSpeedEngine:
             return None
         return tr.export_chrome_trace(path, complete_events=complete_events)
 
-    def _register_mfu_jit(self, name, jit_fn, args, calls_per_step=1.0):
+    def _register_mfu_jit(self, name, jit_fn, args, calls_per_step=1.0,
+                          mem_label=None):
         """Capture-by-shape registration of a dispatched jit with the MFU
-        ledger: a ShapeDtypeStruct tree of the REAL dispatch args is taken
-        once (first dispatch; donated buffers still alive) and the
-        lower+compile+cost_analysis runs lazily at report time — never on
-        the step path, never inside a recompile-guard window."""
+        ledger AND the measured-memory ledger: a ShapeDtypeStruct tree of
+        the REAL dispatch args is taken once (first dispatch; donated
+        buffers still alive) and the lower+compile+cost/memory_analysis
+        runs lazily at report time — never on the step path, never inside
+        a recompile-guard window.  The two ledgers share one compiled
+        object per name (``MemoryAccounting(shared=...)``), so arming
+        both costs ONE compile per jit.  ``mem_label`` additionally arms
+        the analytic-vs-measured transient cross-check for jits the
+        engine makes a budget claim about."""
         tel = self._telemetry
         if tel is None:
             return
@@ -1056,6 +1067,12 @@ class DeepSpeedEngine:
 
         register_by_shape(tel.mfu, name, jit_fn, args, mesh=self.mesh,
                           calls_per_step=calls_per_step)
+        if self._memacct is not None:
+            from deepspeed_tpu.runtime import memory_accounting as mem_acc
+
+            mem_acc.register_by_shape(
+                self._memacct, name, jit_fn, args, mesh=self.mesh,
+                calls_per_step=calls_per_step, expect_label=mem_label)
 
     def _note_mfu_workload(self, batch, micros_in_batch=1):
         """Record the 6ND inputs once: parameter count (from the live
@@ -1121,6 +1138,9 @@ class DeepSpeedEngine:
             # numerical-integrity accounting (ISSUE 13): anomaly/vote
             # ledger, detection latency, false-positive counters
             report["integrity"] = self._integrity.report()
+        # memory leg (ISSUE 15): analytic components always; measured
+        # per-jit memory_analysis + device watermarks when armed
+        report["memory"] = self.memory_report()
         tel = self._telemetry
         if tel is None:
             return report
@@ -1130,6 +1150,137 @@ class DeepSpeedEngine:
         if tel.mfu is not None:
             report["mfu"] = self._mfu_report()
         return report
+
+    # ------------------------------------------------------------------
+    # memory accounting (runtime/memory_accounting.py, ISSUE 15)
+    # ------------------------------------------------------------------
+    def _arm_memory_accounting(self):
+        """Arm the measured side of the HBM accounting when telemetry is
+        on: every step jit registers capture-by-shape with a
+        :class:`runtime.memory_accounting.MemoryAccounting` whose
+        ``memory_analysis()`` reads run lazily at report time, sharing
+        the MFU channel's compile cache (one compile per jit, zero on
+        the step path, zero for a disarmed engine — the compiled
+        programs are untouched either way).  The analytic component
+        model in ``memory_report()`` works armed or not; with
+        ``telemetry.enabled`` on but ``telemetry.memory`` off the
+        measured side is DISARMED with a loud warning, because budgets
+        sized from the analytic model alone are exactly the unchecked
+        estimates this channel exists to catch."""
+        from deepspeed_tpu.runtime.constants import (TELEMETRY_ENABLED,
+                                                     TELEMETRY_MEMORY)
+
+        tc = self._config.telemetry
+        self._memacct = None
+        self._mem_stats_available = None   # None = probe on first step
+        self._lane_mem = 0
+        if not tc[TELEMETRY_ENABLED]:
+            return
+        if not tc[TELEMETRY_MEMORY]:
+            log_dist(
+                "memory accounting: DISARMED — telemetry.memory=false; "
+                "memory_report() will carry the analytic component model "
+                "only, with no measured memory_analysis() cross-check and "
+                "no per-step HBM gauges", ranks=[0],
+                level=logging.WARNING)
+            return
+        from deepspeed_tpu.runtime import memory_accounting as mem_acc
+
+        self._memacct = mem_acc.MemoryAccounting(
+            shared=self._telemetry.mfu if self._telemetry else None)
+        if self._tracer is not None:
+            self._lane_mem = self._tracer.lane("mem")
+            self._tracer.intern("hbm_in_use", args=("bytes", "peak"))
+
+    def _analytic_memory_components(self):
+        """Analytic per-device HBM bytes of the live train state, by
+        component — EXACT shard shapes (each leaf's ``shard_shape`` under
+        its real sharding), not a modeled partition factor.  None before
+        the first batch builds the state."""
+        if self.state is None:
+            return None
+        from deepspeed_tpu.runtime import memory_accounting as mem_acc
+
+        state = self.state
+        components = {
+            "params_bytes": mem_acc.tree_device_bytes(state.params),
+            "grad_accum_bytes": mem_acc.tree_device_bytes(state.accum),
+            "master_bytes": mem_acc.tree_device_bytes(state.master),
+            "optimizer_state_bytes":
+                mem_acc.tree_device_bytes(state.opt_state),
+            "scaler_bytes": mem_acc.tree_device_bytes(state.scaler),
+        }
+        zc = self._config.zero_config
+        transient = {
+            # scheduled stage-3: gathered weights persist fwd->bwd as
+            # vjp residuals — the plan's peak is live on top of the
+            # sharded-at-rest state (the stage3_prefetch_budget number)
+            "gathered_stage3_bytes":
+                self._s3_plan.gathered_bytes
+                if getattr(self, "_s3_sched_armed", False) else 0,
+            "quantization_scratch_bytes": 0,
+        }
+        if getattr(self, "_qgz_armed", False):
+            leaves, _ = self._comm_leaf_specs()
+            transient["quantization_scratch_bytes"] = \
+                mem_acc.quantization_scratch_bytes(
+                    leaves, self.dp_world_size,
+                    zc.quantization_block_size)
+        persistent = sum(components.values())
+        transient_total = sum(transient.values())
+        return {
+            "components": components,
+            "transient": transient,
+            "persistent_bytes": persistent,
+            "transient_bytes": transient_total,
+            "peak_bytes": persistent + transient_total,
+        }
+
+    def memory_report(self):
+        """The memory leg of the accounting trio: analytic per-component
+        state bytes (exact shard shapes), measured per-jit
+        ``memory_analysis()`` with analytic-vs-measured deltas and the
+        arming-time cross-checks, and the per-device ``memory_stats()``
+        watermark + headroom where the backend reports one.  Cold
+        report builder — first call compiles each registered jit's
+        shape-struct lowering (shared with the MFU ledger)."""
+        from deepspeed_tpu.runtime import memory_accounting as mem_acc
+
+        return mem_acc.memory_report(
+            analytic=self._analytic_memory_components(),
+            accounting=self._memacct,
+            devices=list(self.mesh.devices.reshape(-1)),
+            extra={"engine": type(self).__name__})
+
+    def _memory_step_gauges(self):
+        """Per-step ``mem`` gauges: HBM in-use/peak from
+        ``memory_stats()`` where the backend reports it.  The first step
+        probes ONE device; backends with no stats (CPU) disable the path
+        for the rest of the run, so the steady-state cost on an
+        unsupported backend is a single attribute check."""
+        if self._memacct is None or self._mem_stats_available is False:
+            return
+        from deepspeed_tpu.runtime import memory_accounting as mem_acc
+
+        devices = self.mesh.devices.reshape(-1)
+        if self._mem_stats_available is None:
+            self._mem_stats_available = \
+                mem_acc.normalize_memory_stats(devices[0]) is not None
+            if not self._mem_stats_available:
+                return
+        in_use = peak = 0
+        for d in devices:
+            stats = mem_acc.normalize_memory_stats(d)
+            if stats is None:
+                continue
+            in_use += stats.get("bytes_in_use") or 0
+            peak = max(peak, stats.get("peak_bytes_in_use") or 0)
+        reg = self._telemetry.registry
+        reg.gauge("mem_bytes_in_use").set(in_use)
+        reg.gauge("mem_peak_bytes_in_use").set(peak)
+        if self._tracer is not None:
+            self._tracer.instant("hbm_in_use", self._lane_mem,
+                                 a0=in_use, a1=peak)
 
     def _use_loss_scaler(self):
         return self.fp16_enabled()
@@ -2492,8 +2643,11 @@ class DeepSpeedEngine:
                 # scheduled stage-3: the forward does NOT donate the state
                 # — it stays alive; what stages is the vjp stash, whose
                 # residuals hold the once-gathered weights for backward
-                self._register_mfu_jit("s3_fwd", self._jit_s3_fwd,
-                                       (self.state, dev_batch), gas)
+                self._register_mfu_jit(
+                    "s3_fwd", self._jit_s3_fwd, (self.state, dev_batch),
+                    gas, mem_label="stage-3 staged forward: gathered "
+                    "weights + vjp residuals (fwd->bwd stash) — the "
+                    "footprint stage3_prefetch_budget bounds")
                 loss, self._pending_s3_stash = \
                     self._jit_s3_fwd(self.state, dev_batch)
                 self._pending_loss = loss
@@ -2502,8 +2656,10 @@ class DeepSpeedEngine:
                 if self.wall_clock_breakdown():
                     self.timers(FORWARD_MICRO_TIMER).stop()
                 return loss
-            self._register_mfu_jit("micro_step", self._jit_micro,
-                                   (self.state, dev_batch), gas)
+            self._register_mfu_jit(
+                "micro_step", self._jit_micro, (self.state, dev_batch),
+                gas, mem_label="micro step: donated-in-place train state "
+                "+ staged loss + activations")
             if self._offload:
                 new_state, loss, grads = self._jit_micro(self.state,
                                                          dev_batch)
@@ -2539,6 +2695,10 @@ class DeepSpeedEngine:
             # donate it — the gathered weights free here, at wgrad
             import jax
 
+            gas = self.gradient_accumulation_steps()
+            self._register_mfu_jit("s3_bwd", self._jit_s3_bwd,
+                                   (self.state, self._pending_s3_stash),
+                                   gas)
             with jax.set_mesh(self.mesh):
                 self.state = self._jit_s3_bwd(self.state,
                                               self._pending_s3_stash)
@@ -2839,8 +2999,11 @@ class DeepSpeedEngine:
         _t0 = tr.begin() if tr is not None else 0.0
         with jax.set_mesh(self.mesh):
             fused_fn = self._fused_callable()
-            self._register_mfu_jit("fused_train_step", fused_fn,
-                                   (self.state, dev, jnp.float32(lr)))
+            self._register_mfu_jit(
+                "fused_train_step", fused_fn,
+                (self.state, dev, jnp.float32(lr)),
+                mem_label="fused train step: donated-in-place state + "
+                "step metrics + per-micro activations")
             new_state, metrics = fused_fn(self.state, dev, jnp.float32(lr))
         self.state = new_state
         self.global_steps += 1
@@ -3019,6 +3182,10 @@ class DeepSpeedEngine:
             # stream) — pure host dict work, nothing on the device path
             self._supervisor.on_engine_step(self)
         if self._telemetry is not None:
+            # `mem` lane gauges: HBM in-use/peak watermark per step where
+            # the backend reports memory_stats (no-op after one probe on
+            # backends that don't — the CPU mesh)
+            self._memory_step_gauges()
             # step-aligned telemetry boundary: step_time histogram + one
             # JSONL record of this step's metrics (journal idiom — flush
             # per emit, a crash tears at most the final line)

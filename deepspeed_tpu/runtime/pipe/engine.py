@@ -54,34 +54,56 @@ class StageState(NamedTuple):
 
 
 class _MfuJitProxy:
-    """Transparent stage-jit wrapper for the MFU ledger: on FIRST dispatch
-    it captures a ShapeDtypeStruct tree of the real args and registers a
-    lazy lower+compile with telemetry/mfu.py, then calls through.  Only
-    installed when telemetry MFU is armed — the disarmed hot path runs
-    the bare jit.  Attribute access (``.lower`` for the HLO contract
-    tests) passes through to the wrapped jit."""
+    """Transparent stage-jit wrapper for the MFU and measured-memory
+    ledgers: on FIRST dispatch it captures a ShapeDtypeStruct tree of the
+    real args and registers a lazy lower+compile with telemetry/mfu.py
+    (and, when the engine arms memory accounting, the same name with
+    runtime/memory_accounting.py — the two share ONE compiled object per
+    jit), then calls through.  Only installed when a ledger is armed —
+    the disarmed hot path runs the bare jit.  Attribute access
+    (``.lower`` for the HLO contract tests) passes through to the
+    wrapped jit."""
 
     # __weakref__: jax.eval_shape / linear_util cache weakref their
     # callables (the stash-size estimate abstract-evals fwd_stash
     # through this proxy)
-    __slots__ = ("fn", "name", "mfu", "mesh", "calls", "_registered",
-                 "__weakref__")
+    __slots__ = ("fn", "name", "mfu", "mem", "mesh", "calls",
+                 "_registered", "__weakref__")
 
-    def __init__(self, fn, name, mfu, mesh, calls):
+    def __init__(self, fn, name, mfu, mesh, calls, mem=None):
         self.fn = fn
         self.name = name
         self.mfu = mfu
+        self.mem = mem
         self.mesh = mesh
         self.calls = calls
         self._registered = False
 
     def __call__(self, *args):
         if not self._registered:
-            self._registered = True
-            from deepspeed_tpu.telemetry import register_by_shape
+            import jax
 
-            register_by_shape(self.mfu, self.name, self.fn, args,
-                              mesh=self.mesh, calls_per_step=self.calls)
+            # register only from a CONCRETE dispatch: under an abstract
+            # evaluation (the stash-size estimate eval_shapes fwd_stash
+            # through this proxy) the args are tracers with no
+            # shardings — capturing them would re-lower the UNsharded
+            # whole-stage program, inflating per-device cost/memory and
+            # breaking the per-device HFU premise
+            if not any(isinstance(l, jax.core.Tracer)
+                       for l in jax.tree_util.tree_leaves(args)):
+                self._registered = True
+                from deepspeed_tpu.telemetry import register_by_shape
+
+                register_by_shape(self.mfu, self.name, self.fn, args,
+                                  mesh=self.mesh,
+                                  calls_per_step=self.calls)
+                if self.mem is not None:
+                    from deepspeed_tpu.runtime import \
+                        memory_accounting as mem_acc
+
+                    mem_acc.register_by_shape(
+                        self.mem, self.name, self.fn, args,
+                        mesh=self.mesh, calls_per_step=self.calls)
         return self.fn(*args)
 
     def __getattr__(self, item):
@@ -666,16 +688,20 @@ class PipelineEngine(DeepSpeedEngine):
                     bwd_wgrad_last_stash if is_last else bwd_wgrad_mid_stash,
                     donate_argnums=(0, 1))
             tel = self._telemetry
-            if tel is not None and tel.mfu is not None:
-                # per-compute-jit FLOPs for the MFU ledger: fwd/bwd kinds
-                # run once per micro per chunk, the reductions/apply once
-                # per optimizer step
+            mem = self._memacct
+            if (tel is not None and tel.mfu is not None) \
+                    or mem is not None:
+                # per-compute-jit FLOPs/bytes for the MFU + memory
+                # ledgers: fwd/bwd kinds run once per micro per chunk,
+                # the reductions/apply once per optimizer step
                 per_micro = {"fwd", "fwd_stash", "bwd_last", "bwd_mid",
                              "bwd_dgrad", "bwd_wgrad", "bwd_dgrad_stash",
                              "bwd_wgrad_stash"}
+                mfu = tel.mfu if tel is not None else None
                 jits = {
-                    k: _MfuJitProxy(v, f"chunk{s}:{k}", tel.mfu, submesh,
-                                    gas if k in per_micro else 1.0)
+                    k: _MfuJitProxy(v, f"chunk{s}:{k}", mfu, submesh,
+                                    gas if k in per_micro else 1.0,
+                                    mem=mem)
                     if (v is not None and k != "mesh") else v
                     for k, v in jits.items()}
             self._stage_jits.append(jits)
@@ -683,28 +709,48 @@ class PipelineEngine(DeepSpeedEngine):
     def _stash_bytes_estimate(self, sample_micro):
         """Per-chunk, per-micro stash bytes (the vjp-residual leaves of one
         fwd_stash call), by abstract evaluation — no device work.  Chains
-        the chunk output shapes forward exactly as the executor does."""
+        the chunk output shapes forward exactly as the executor does.
+        Also records the FULL fwd_stash output footprint per chunk
+        (stash + boundary activation/loss) in
+        ``_stash_out_bytes_per_chunk`` — the analytic side of the
+        memory-accounting cross-check against the compiled program's
+        measured output+temp bytes."""
         import jax
+
+        from deepspeed_tpu.runtime import memory_accounting as mem_acc
+
+        def tree_bytes(tree):
+            # the shared analytic primitive — one byte-pricing
+            # implementation for both sides of the cross-check
+            return sum(mem_acc.bytes_of(l.shape, l.dtype)
+                       for l in jax.tree_util.tree_leaves(tree))
 
         C = self.num_chunks
         rng = jax.random.PRNGKey(0)
         scale = np.float32(1.0)
         x = self.module.input_fn(sample_micro)
-        out = []
+        out, out_full = [], []
         for q in range(C):
             jits = self._stage_jits[q]
+            # analytic transient bound per chunk: outputs (stash +
+            # boundary activation/loss) + one argument-sized working set
+            args_b = tree_bytes(self.stage_states[q].params) \
+                + tree_bytes(x)
             with jax.set_mesh(self._chunk_mesh(q)):
                 if q < C - 1:
                     x, _aux, stash = jax.eval_shape(
                         jits["fwd_stash"], self.stage_states[q].params,
                         x, rng)
+                    extra = tree_bytes((x, _aux))
                 else:
+                    args_b += tree_bytes(sample_micro)
                     _loss, stash = jax.eval_shape(
                         jits["fwd_stash"], self.stage_states[q].params,
                         x, rng, sample_micro, scale)
-            out.append(sum(
-                int(np.prod(l.shape)) * np.dtype(l.dtype).itemsize
-                for l in jax.tree_util.tree_leaves(stash)))
+                    extra = tree_bytes(_loss)
+            out.append(tree_bytes(stash))
+            out_full.append(out[-1] + extra + args_b)
+        self._stash_out_bytes_per_chunk = out_full
         return out
 
     def _arm_stash(self, sample_micro):
@@ -768,6 +814,21 @@ class PipelineEngine(DeepSpeedEngine):
                         ranks=[0], level=logging.WARNING)
         self._stash_blockers = blockers
         self._stash_armed = not blockers
+        if self._stash_armed and self._memacct is not None \
+                and per_chunk is not None:
+            # analytic-vs-measured cross-check (ISSUE 15): the same
+            # residual estimate the stash_budget gate was sized from,
+            # checked at report time against the compiled fwd_stash's
+            # measured output+temp bytes — a >15% underestimate warns
+            # that the budget under-provisions
+            for q in range(self.num_chunks):
+                self._memacct.expect(
+                    f"chunk{q}:fwd_stash",
+                    f"zb stash forward chunk {q}: vjp residuals "
+                    f"({per_chunk[q]} B analytic, the stash_budget "
+                    f"input) + boundary outputs",
+                    self._stash_out_bytes_per_chunk[q],
+                    field="transient_bytes")
         if self._stash_armed:
             import warnings
 
@@ -1325,6 +1386,56 @@ class PipelineEngine(DeepSpeedEngine):
             "max_abs_idle_error": max(
                 abs(m - a) for m, a in zip(measured["idle_fraction"],
                                            analytic["idle_fraction"])),
+        }
+
+    def _analytic_memory_components(self):
+        """Pipeline analytic memory: per-STAGE component bytes (each
+        stage is a separate submesh, so the watermark that matters is
+        the worst stage, not a sum across them), chunk states aggregated
+        onto their owner stages, plus the ZB stash residual peak per
+        stage when stashing is armed.  None before the first batch."""
+        if self.stage_states is None:
+            return None
+        from deepspeed_tpu.runtime import memory_accounting as mem_acc
+        from deepspeed_tpu.runtime.pipe import bubble_accounting as ba
+
+        S = self.num_stages
+        per_stage = [{"params_bytes": 0, "master_bytes": 0,
+                      "optimizer_state_bytes": 0, "grad_accum_bytes": 0}
+                     for _ in range(S)]
+        for q, st in enumerate(self.stage_states):
+            s = self.grid.chunk_owner_stage(q)
+            per_stage[s]["params_bytes"] += \
+                mem_acc.tree_device_bytes(st.params)
+            per_stage[s]["master_bytes"] += \
+                mem_acc.tree_device_bytes(st.master)
+            per_stage[s]["optimizer_state_bytes"] += \
+                mem_acc.tree_device_bytes(st.opt_state)
+            per_stage[s]["grad_accum_bytes"] += \
+                mem_acc.tree_device_bytes(st.accum)
+        stash_peak = [0] * S
+        if self._stash_armed and self._stash_bytes_per_chunk is not None:
+            rep = ba.simulate(self._ensure_compiled_schedule())
+            for s, peak in enumerate(rep["peak_live_stash"]):
+                stash_peak[s] = peak * self._stash_bytes_per_chunk[s]
+        stages = []
+        for s in range(S):
+            persistent = sum(per_stage[s].values())
+            stages.append({
+                "components": per_stage[s],
+                "transient": {"stash_bytes": stash_peak[s]},
+                "persistent_bytes": persistent,
+                "peak_bytes": persistent + stash_peak[s],
+            })
+        worst = max(range(S), key=lambda s: stages[s]["peak_bytes"])
+        return {
+            "per_stage": stages,
+            "persistent_bytes": stages[worst]["persistent_bytes"],
+            "transient_bytes": stages[worst]["transient"]["stash_bytes"],
+            # devices are per stage: the fleet watermark is the worst
+            # stage's peak, not the sum over submeshes
+            "peak_bytes": stages[worst]["peak_bytes"],
+            "worst_stage": worst,
         }
 
     def telemetry_report(self):

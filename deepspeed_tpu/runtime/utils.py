@@ -200,21 +200,24 @@ def clip_grad_by_global_norm(grads, max_norm, global_norm=None):
 
 
 def see_memory_usage(message, force=False):
+    """Log per-device HBM usage — a thin delegate over the ONE
+    memory_stats() normalizer (runtime/memory_accounting.py), so every
+    probe in the repo renders the same per-backend variants the same
+    way (None on CPU = silently no line, never a crash)."""
     if not force:
         return
-    import jax
+    from deepspeed_tpu.runtime.memory_accounting import \
+        device_memory_report
 
     lines = [message]
-    for d in jax.local_devices():
-        try:
-            stats = d.memory_stats() or {}
-        except Exception:
-            stats = {}
-        if stats:
-            lines.append(
-                f"  {d}: in_use={stats.get('bytes_in_use', 0)/2**30:.2f}GB "
-                f"peak={stats.get('peak_bytes_in_use', 0)/2**30:.2f}GB "
-                f"limit={stats.get('bytes_limit', 0)/2**30:.2f}GB")
+    for entry in device_memory_report():
+        if entry["bytes_in_use"] is None:
+            continue
+        lines.append(
+            f"  {entry['kind']}:{entry['id']}: "
+            f"in_use={(entry['bytes_in_use'] or 0)/2**30:.2f}GB "
+            f"peak={(entry['peak_bytes_in_use'] or 0)/2**30:.2f}GB "
+            f"limit={(entry['bytes_limit'] or 0)/2**30:.2f}GB")
     logger.info("\n".join(lines))
 
 

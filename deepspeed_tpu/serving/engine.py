@@ -359,6 +359,7 @@ class InferenceEngine:
         self._tracer = None
         self._owns_telemetry = False
         self._lane_serve = 0
+        self._memacct = None
         if spec is None:
             return
         from deepspeed_tpu.telemetry import Telemetry
@@ -386,6 +387,13 @@ class InferenceEngine:
             self._tracer.intern("serving_step", args=("step",))
             self._tracer.intern("decode_step", args=("lanes",))
             self._tracer.intern("admit", args=("rid",))
+        # measured HBM accounting (ISSUE 15): per-jit memory_analysis()
+        # registered capture-by-shape alongside MFU, sharing its lazy
+        # compile cache — one compile per jit, zero on the decode path
+        from deepspeed_tpu.runtime.memory_accounting import \
+            MemoryAccounting
+
+        self._memacct = MemoryAccounting(shared=tel.mfu)
 
     def export_trace(self, path, complete_events=True):
         """Chrome-trace JSON of the retained events (None when tracing
@@ -868,6 +876,9 @@ class InferenceEngine:
         # them for back-compat
         rep["telemetry_armed"] = tel is not None
         rep["telemetry"] = {"armed": tel is not None}
+        # memory leg (ISSUE 15): pool + params analytic always, measured
+        # per-jit memory_analysis when telemetry is armed
+        rep["memory"] = self.memory_report()
         if tel is None:
             return rep
         rep["metrics"] = rep["telemetry"]["metrics"] = \
@@ -893,6 +904,34 @@ class InferenceEngine:
             rep["mfu"]["n_params"] = n_params
             rep["mfu"]["tokens_per_step"] = self.max_slots
         return rep
+
+    def memory_report(self) -> dict:
+        """The serving face of the memory accounting (ISSUE 15):
+        analytic device bytes — replicated params plus the paged KV
+        block pool, priced through the SAME
+        ``memory_accounting.kv_pool_bytes`` builder the pool's own
+        ``stats()`` uses (byte-exact vs the allocated arrays) — next to
+        the measured per-jit ``memory_analysis()`` of the decode/prefill
+        programs and the per-device ``memory_stats()`` watermark.  Cold
+        report builder: never call it from the step loop."""
+        from deepspeed_tpu.runtime import memory_accounting as mem_acc
+
+        pool_bytes = self.pool.device_bytes()
+        params_bytes = mem_acc.tree_device_bytes(self.params)
+        analytic = {
+            "components": {
+                "params_bytes": params_bytes,
+                "kv_pool_bytes": pool_bytes,
+            },
+            "persistent_bytes": params_bytes + pool_bytes,
+            "transient_bytes": 0,
+            "peak_bytes": params_bytes + pool_bytes,
+        }
+        devices = list(self.mesh.devices.reshape(-1)) \
+            if self.mesh is not None else None
+        return mem_acc.memory_report(
+            analytic=analytic, accounting=self._memacct, devices=devices,
+            extra={"engine": type(self).__name__})
 
     def decode_hlo(self) -> str:
         """Compiled HLO of the decode program (for the graftlint HLO
@@ -1057,6 +1096,17 @@ class InferenceEngine:
             self.temperature, self.top_k, self.top_p, self.mesh,
             self.axis_name)
         rows, nv = self._prefill_args(req, n)
+        if self.telemetry is not None:
+            # every bucketed prefill jit joins the MFU + memory ledgers
+            # (capture-by-shape, no-op after the first registration)
+            from deepspeed_tpu.runtime import memory_accounting as mem_acc
+            from deepspeed_tpu.telemetry import register_by_shape
+
+            pf_name = f"prefill_chunk{bucket}" + ("_final" if final else "")
+            pf_args = (self.params, *self.pool.tensors.arrays, rows,
+                       tok_pad, np.int32(start), nv, np.int32(req.seed))
+            register_by_shape(self.telemetry.mfu, pf_name, fn, pf_args)
+            mem_acc.register_by_shape(self._memacct, pf_name, fn, pf_args)
         out = fn(self.params, *self.pool.tensors.arrays, rows, tok_pad,
                  np.int32(start), nv, np.int32(req.seed))
         req.work_done += n
@@ -1107,13 +1157,18 @@ class InferenceEngine:
             # capture-by-shape BEFORE dispatch (the pool is donated by
             # it); the lower+compile runs lazily at report time, outside
             # any recompile-guard window
+            from deepspeed_tpu.runtime import memory_accounting as mem_acc
             from deepspeed_tpu.telemetry import register_by_shape
 
-            register_by_shape(
-                tel.mfu, "decode_step", self._decode,
-                (self.params, *self.pool.tensors.arrays, self._tables,
-                 self._pos, self._tok, self._active, self._seeds,
-                 self._poison))
+            decode_args = (self.params, *self.pool.tensors.arrays,
+                           self._tables, self._pos, self._tok,
+                           self._active, self._seeds, self._poison)
+            register_by_shape(tel.mfu, "decode_step", self._decode,
+                              decode_args)
+            mem_acc.register_by_shape(
+                self._memacct, "decode_step", self._decode, decode_args,
+                expect_label="serving decode step: donated-in-place KV "
+                "block pool + sampled tokens")
         out = self._decode(self.params, *self.pool.tensors.arrays,
                            self._tables, self._pos, self._tok,
                            self._active, self._seeds, self._poison)

@@ -198,6 +198,19 @@ class PagedKVPool:
         return len(self._blocks.get(rid, ()))
 
     # -- accounting -----------------------------------------------------
+    def device_bytes(self) -> int:
+        """Per-shard device bytes of the pool tensors, priced through
+        the shared analytic builder (``memory_accounting.
+        kv_pool_bytes``) — byte-exact against the allocated k/v (+
+        scale) arrays, asserted by tests/unit/test_memory_accounting."""
+        from deepspeed_tpu.runtime.memory_accounting import kv_pool_bytes
+
+        cfg = self.cfg
+        return kv_pool_bytes(
+            cfg.n_layer, self.num_blocks, cfg.n_head, self.block_size,
+            cfg.head_dim, kv_dtype=np.dtype(self.dtype).name,
+            quantized=self.quantized, shards=self.shards)
+
     @property
     def usable_blocks(self) -> int:
         return self.num_blocks - self.shards          # minus trash blocks
@@ -221,6 +234,7 @@ class PagedKVPool:
 
     def stats(self) -> dict:
         return {
+            "pool_device_bytes": self.device_bytes(),
             "blocks_total": self.usable_blocks,
             "blocks_in_use": self.blocks_in_use,
             "occupancy": self.occupancy(),

@@ -11,7 +11,11 @@ questions:
 - **hardware FLOPs**: summed ``compiled.cost_analysis()["flops"]`` over
   every registered jitted program × its calls per step — what XLA
   actually scheduled, including remat recompute, so
-  ``HFU >= MFU`` and the gap IS the recompute/padding tax.
+  ``HFU >= MFU`` and the gap IS the recompute/padding tax.  Because
+  the capture preserves shardings, the compiled program (and so its
+  cost) is the PER-DEVICE SPMD executable — ``hfu`` therefore divides
+  by ``step_time × peak`` alone, while ``mfu`` divides the global model
+  FLOPs by ``step_time × n_devices × peak``.
 
 Registration is capture-by-shape: engines register a zero-arg
 ``make_compiled`` closure (built from ``jax.ShapeDtypeStruct`` trees of
@@ -75,24 +79,41 @@ def model_flops_per_step(n_params, tokens_per_step, fwd_only=False):
         * float(tokens_per_step)
 
 
+def shape_structs(args):
+    """``jax.ShapeDtypeStruct`` tree of real dispatch args (non-array
+    leaves coerced through numpy), PRESERVING each leaf's NamedSharding:
+    a sharded program re-lowered from unsharded structs is a different
+    program (and donation aliasing can refuse to compile it at all), so
+    the structs must carry the placement for the capture to be faithful.
+    Shared by the MFU and memory-accounting registrations."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    def struct(x):
+        if not hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+        sh = getattr(x, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+        return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+    return jax.tree_util.tree_map(struct, args)
+
+
 def register_by_shape(mfu, name, jit_fn, args, mesh=None,
                       calls_per_step=1.0):
     """THE capture-by-shape registration every engine uses: take a
     ``jax.ShapeDtypeStruct`` tree of the REAL dispatch args NOW (donated
-    buffers still alive, non-array leaves coerced through numpy) and
-    register a lazy ``lower().compile()`` closure — run once, at report
-    time, under ``mesh`` when one is given — so the compile never lands
-    on the step path or inside a recompile-guard window.  No-op when
+    buffers still alive, shardings preserved) and register a lazy
+    ``lower().compile()`` closure — run once, at report time, under
+    ``mesh`` when one is given — so the compile never lands on the step
+    path or inside a recompile-guard window.  No-op when
     ``mfu``/``jit_fn`` is None or ``name`` is already registered."""
     if mfu is None or jit_fn is None or mfu.has(name):
         return
     import jax
 
-    structs = jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
-        if hasattr(x, "dtype")
-        else jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
-        args)
+    structs = shape_structs(args)
 
     def make_compiled():
         if mesh is None:
@@ -111,6 +132,7 @@ class MfuAccounting:
         self.peak_tflops_per_device = float(peak_tflops_per_device or 0.0)
         self._jits = {}        # name -> (make_compiled, calls_per_step)
         self._costs = {}       # name -> normalized cost dict (lazy)
+        self._compiled = {}    # name -> compiled object (lazy, shared)
         self._lock = threading.Lock()
 
     def has(self, name):
@@ -125,17 +147,35 @@ class MfuAccounting:
             if name not in self._jits:
                 self._jits[name] = (make_compiled, float(calls_per_step))
 
+    def calls_per_step(self, name):
+        """Registered calls-per-step factor (None when unregistered)."""
+        entry = self._jits.get(name)
+        return entry[1] if entry is not None else None
+
+    def compiled(self, name):
+        """The lazily-compiled object for one registered program, cached
+        so every ledger reading this registry (FLOPs here, bytes in
+        runtime/memory_accounting.py) pays ONE ``lower().compile()`` per
+        jit between them.  Raises whatever the lowering raised; returns
+        None for unregistered names."""
+        entry = self._jits.get(name)
+        if entry is None:
+            return None
+        if name not in self._compiled:
+            self._compiled[name] = entry[0]()
+        return self._compiled[name]
+
     def costs(self):
         """{name: {flops, bytes_accessed, calls_per_step}} — compiled
         lazily on first call, cached after.  A program whose lowering
         fails reports its error string instead of poisoning the rest."""
         with self._lock:
             jits = dict(self._jits)
-        for name, (make_compiled, calls) in jits.items():
+        for name, (_make, calls) in jits.items():
             if name in self._costs:
                 continue
             try:
-                cost = normalize_cost_analysis(make_compiled())
+                cost = normalize_cost_analysis(self.compiled(name))
             except Exception as e:  # lint: allow-broad-except — one
                 # program's lowering quirk must not kill the report
                 cost = {"flops": None, "bytes_accessed": None,
@@ -179,11 +219,14 @@ class MfuAccounting:
             "achieved_tflops_per_device":
                 (model_flops / denom / 1e12)
                 if (denom and model_flops) else None,
+            # hw flops are PER-DEVICE (the sharded SPMD executable's own
+            # cost_analysis): no n_devices in the hardware denominators
             "achieved_hw_tflops_per_device":
-                (hw_flops / denom / 1e12) if (denom and hw_flops) else None,
+                (hw_flops / step_time_s / 1e12)
+                if (step_time_s and hw_flops) else None,
             "mfu": (model_flops / (denom * peak))
             if (denom and model_flops and peak) else None,
-            "hfu": (hw_flops / (denom * peak))
-            if (denom and hw_flops and peak) else None,
+            "hfu": (hw_flops / (step_time_s * peak))
+            if (step_time_s and hw_flops and peak) else None,
         }
         return out

@@ -70,18 +70,19 @@ class SynchronizedWallClockTimer:
 
     @staticmethod
     def memory_usage() -> str:
-        import jax
+        # thin delegate over runtime/memory_accounting.py — THE one
+        # normalizer for the per-backend memory_stats() variants
+        from deepspeed_tpu.runtime.memory_accounting import \
+            device_memory_report
 
         lines = []
-        for d in jax.local_devices():
-            try:
-                stats = d.memory_stats()
-            except Exception:
-                stats = None
-            if stats:
-                used = stats.get("bytes_in_use", 0) / (1024**3)
-                peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
-                lines.append(f"{d}: in_use {used:.2f} GB | peak {peak:.2f} GB")
+        for entry in device_memory_report():
+            if entry["bytes_in_use"] is None:
+                continue
+            used = entry["bytes_in_use"] / (1024**3)
+            peak = (entry["peak_bytes_in_use"] or 0) / (1024**3)
+            lines.append(f"{entry['kind']}:{entry['id']}: "
+                         f"in_use {used:.2f} GB | peak {peak:.2f} GB")
         return " | ".join(lines)
 
     def log(self, names, normalizer=1.0, reset=True, ranks=None, memory_breakdown=False):

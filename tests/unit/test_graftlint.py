@@ -1187,3 +1187,84 @@ def test_disarmed_discipline_covers_arm_telemetry():
     got = lint(ARM_TELEMETRY_BAD, rules=["disarmed-discipline"])
     assert rule_names(got) == ["disarmed-discipline"]
     assert lint(ARM_TELEMETRY_GOOD, rules=["disarmed-discipline"]) == []
+
+
+# ---------------------------------------------------------------------------
+# memory accounting (ISSUE 15): cold report builders + arming discipline
+# ---------------------------------------------------------------------------
+
+HS_MEMORY_READ_BAD = """
+class E:
+    def train_batch(self, batch):
+        loss = self._jit(batch)
+        watermark = self.memory_report()
+        return loss, watermark
+"""
+
+HS_MEASURED_READ_BAD = """
+class E:
+    def step(self):
+        self._take_step()
+        self._last_mem = self._memacct.measured_memory()
+"""
+
+HS_MEMORY_READ_GOOD = """
+class E:
+    def memory_report(self):
+        return build_report(self._analytic_memory_components(),
+                            self._memacct.measured_memory(),
+                            device_memory_report())
+
+    def train_batch(self, batch):
+        return self._jit(batch)
+"""
+
+
+def test_host_sync_flags_measured_memory_read_in_hot_fn():
+    """ISSUE 15 satellite: a measured-memory read (memory_report /
+    measured_memory — lazy compiles + whole-tree walks) inside a hot
+    step fn is a finding; the same builders called from a cold report
+    fn are quiet."""
+    path = "deepspeed_tpu/runtime/engine.py"
+    got = lint(HS_MEMORY_READ_BAD, path, rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"]
+    assert "arming time" in got[0].message
+    got = lint(HS_MEASURED_READ_BAD, path, rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"]
+    assert lint(HS_MEMORY_READ_GOOD, path, rules=["host-sync"]) == []
+    # the bar applies to engine/bench hot fns only
+    assert lint(HS_MEMORY_READ_BAD, "tools/somefile.py",
+                rules=["host-sync"]) == []
+
+
+def test_host_sync_flags_memory_read_in_bench_timed_region():
+    # bench files hold EVERY fn to the bar — the one blessed read in
+    # bench.py carries an inline suppression
+    got = lint(HS_MEMORY_READ_BAD, "bench.py", rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"]
+
+
+ARM_MEMORY_BAD = """
+class E:
+    def _arm_memory_accounting(self):
+        self._memacct = None
+        if self.config.telemetry_enabled and self.config.memory:
+            self._memacct = MemoryAccounting(shared=self._telemetry.mfu)
+"""
+
+ARM_MEMORY_GOOD = ARM_MEMORY_BAD + """
+        elif self.config.telemetry_enabled:
+            log_dist("memory accounting: DISARMED — telemetry.memory="
+                     "false; memory_report() stays analytic-only",
+                     ranks=[0], level=logging.WARNING)
+"""
+
+
+def test_disarmed_discipline_covers_arm_memory_accounting():
+    """ISSUE 15 satellite: the memory-accounting arming fn is held to
+    the armed-or-warns discipline — a silent analytic-only fallback
+    fires; warning DISARMED quiets it."""
+    got = lint(ARM_MEMORY_BAD, rules=["disarmed-discipline"])
+    assert rule_names(got) == ["disarmed-discipline"]
+    assert "_arm_memory_accounting" in got[0].message
+    assert lint(ARM_MEMORY_GOOD, rules=["disarmed-discipline"]) == []

@@ -1,0 +1,152 @@
+"""Peak-HBM regression guard runs as part of the suite (the comm_budget
+pattern): a change that fattens a resident memory component — or an
+analytic model that drifts under the compiler's own numbers — fails
+tests, without a separate CI system."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from mem_budget import (BUDGET_PATH, compute_peaks,  # noqa: E402
+                        write_budgets)
+from comm_budget import check_budgets  # noqa: E402
+from tests.unit.simple_model import SimpleModel, random_dataloader
+
+
+def test_budget_table_checked_in_and_current():
+    """The repo's budget table exists and today's analytic peaks are
+    within the 10% growth tolerance of it."""
+    assert os.path.exists(BUDGET_PATH), \
+        "tools/memory_budgets.json missing; run tools/mem_budget.py " \
+        "--update"
+    with open(BUDGET_PATH) as f:
+        budgets = json.load(f)
+    violations = check_budgets(compute_peaks(), budgets)
+    assert not violations, violations
+
+
+def test_zero_ladder_encoded_in_budgets():
+    """The budget table itself encodes the ZeRO memory headline: every
+    stage strictly shrinks the per-device persistent footprint, and
+    offload shrinks it below stage 2."""
+    peaks = compute_peaks()
+    s0 = peaks["gpt2-350m-ish/dp8/stage0/fp32"]["persistent_bytes"]
+    s1 = peaks["gpt2-350m-ish/dp8/stage1/bf16"]["persistent_bytes"]
+    s2 = peaks["gpt2-350m-ish/dp8/stage2/bf16"]["persistent_bytes"]
+    off = peaks["gpt2-350m-ish/dp8/stage2/bf16-offload"][
+        "persistent_bytes"]
+    s3 = peaks["gpt2-350m-ish/dp8/stage3/bf16-scheduled"][
+        "persistent_bytes"]
+    assert s0 > s1 > s2 > off
+    assert s3 < s2                       # params shard too under stage 3
+    # int8 KV pool beats bf16 (the scale overhead is priced in)
+    assert peaks["serving/gpt2-350m-ish/decode-b8/pool-int8"][
+        "peak_bytes"] < peaks[
+        "serving/gpt2-350m-ish/decode-b8/pool-bf16"]["peak_bytes"]
+
+
+def test_growth_detected_and_known_bad_trips_gate():
+    """A >10% peak regression against the budget fails; <=10% passes —
+    the known-bad fixture is the live table with one budget deflated."""
+    peaks = compute_peaks()
+    name = "gpt2-350m-ish/dp8/stage2/bf16"
+    bad = {n: {k: (int(v / 1.2) or 1 if n == name else v)
+               for k, v in d.items()} for n, d in peaks.items()}
+    violations = check_budgets(peaks, bad)
+    assert violations and all(v[0] == name for v in violations)
+    ok = {n: dict(d) for n, d in peaks.items()}
+    assert check_budgets(peaks, ok) == []
+    # within-tolerance drift passes
+    drift = {n: {k: int(v * 0.95) or 1 for k, v in d.items()}
+             for n, d in peaks.items()}
+    assert check_budgets(peaks, drift) == []
+
+
+def test_missing_config_is_a_violation():
+    peaks = compute_peaks()
+    partial = dict(peaks)
+    missing = sorted(partial)[0]
+    del partial[missing]
+    violations = check_budgets(peaks, partial)
+    assert any(v[0] == missing for v in violations)
+
+
+def test_update_is_deterministic_and_atomic(tmp_path):
+    """--update regenerates byte-identical output (sorted keys) and
+    leaves no temp file behind — the committed table is reproducible."""
+    p1 = str(tmp_path / "a.json")
+    p2 = str(tmp_path / "b.json")
+    write_budgets(compute_peaks(), p1)
+    write_budgets(compute_peaks(), p2)
+    with open(p1, "rb") as f1, open(p2, "rb") as f2:
+        b1, b2 = f1.read(), f2.read()
+    assert b1 == b2 and b1.endswith(b"\n")
+    assert json.loads(b1) == compute_peaks()
+    assert sorted(json.loads(b1)) == list(json.loads(b1))
+    assert not os.path.exists(p1 + ".tmp")
+    # regenerating over the committed table reproduces it exactly —
+    # every entry in the repo is byte-stable against current code
+    with open(BUDGET_PATH, "rb") as f:
+        committed = f.read()
+    p3 = str(tmp_path / "c.json")
+    write_budgets(compute_peaks(), p3)
+    with open(p3, "rb") as f:
+        assert f.read() == committed
+
+
+def test_tool_exits_clean_on_repo():
+    """The same tier-1 guard that runs comm_budget: both budget tools
+    exit 0 against the committed tables."""
+    for tool in ("comm_budget.py", "mem_budget.py"):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", tool)],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, (tool, proc.stdout + proc.stderr)
+
+
+# ---------------------------------------------------------------------------
+# analytic-vs-measured contract (the cross-check the budgets rely on)
+# ---------------------------------------------------------------------------
+
+def test_stage2_micro_jit_measured_within_analytic_contract():
+    """THE contract that makes the analytic budgets trustworthy: on the
+    stage-2 micro jit, the compiler's measured transient (temp + output
+    bytes from memory_analysis()) stays within the analytic model's
+    bound x 1.15, the measured argument bytes match the shard-shape
+    model near-exactly, and the cross-check records no underestimate."""
+    cfg = {
+        "train_batch_size": 8, "steps_per_print": 100,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "zero_optimization": {"stage": 2},
+        "telemetry": {"enabled": True, "peak_tflops_per_device": 0.001},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(16),
+                                               config_params=cfg)
+    it = random_dataloader(
+        16, 64,
+        engine.train_micro_batch_size_per_gpu() * engine.dp_world_size,
+        seed=0)
+    for _ in range(2):
+        loss = engine.forward(next(it))
+        engine.backward(loss)
+        engine.step()
+    rep = engine.memory_report()
+    m = rep["measured"]["micro_step"]
+    assert m["modeled"] and m["temp_bytes"] is not None
+    # argument side: exact shard-shape pricing (alignment slack only)
+    assert abs(m["argument_delta"]) <= 0.15
+    check = rep["cross_check"]["micro_step"]
+    assert not check["underestimated"]
+    assert m["transient_bytes"] <= check["analytic_bytes"] * 1.15

@@ -230,7 +230,9 @@ def test_mfu_report_math():
     assert rep["hw_flops_per_step"] == pytest.approx(2.0 * flops)
     # mfu = model_flops / (t * n_dev * peak) = 1e6 / (1*2*1e6) = 0.5
     assert rep["mfu"] == pytest.approx(0.5)
-    assert rep["hfu"] == pytest.approx(2 * flops / 2e6)
+    # hw flops are per-device (sharding-preserving capture compiles the
+    # SPMD executable): hfu = hw / (t * peak), no n_devices factor
+    assert rep["hfu"] == pytest.approx(2 * flops / 1e6)
     assert rep["peak_known"] and rep["hw_flops_complete"]
 
 

@@ -98,9 +98,18 @@ TELEMETRY_FILES = {"deepspeed_tpu/telemetry/trace.py",
 # cold-path builders: O(param-leaves) host work (tree flattening, shape
 # math, spec construction) that belongs at arming/compile time.  A call
 # from a hot step-driving function — even outside a loop — rebuilds the
-# plan every step, so it is flagged anywhere inside a hot fn.
+# plan every step, so it is flagged anywhere inside a hot fn.  The
+# memory-accounting report builders (ISSUE 15) are held to the same
+# bar: a measured-memory read (memory_report / measured_memory /
+# device_memory_report / train_memory_report) lazily COMPILES every
+# registered jit on first call and walks whole state trees after —
+# report-time work, never step-time.
 COLD_BUILDER_NAMES = {"build_gather_plan", "_arm_stage3",
-                      "_arm_quantized_collectives", "_build_shardings"}
+                      "_arm_quantized_collectives", "_build_shardings",
+                      "memory_report", "measured_memory",
+                      "device_memory_report", "train_memory_report",
+                      "_analytic_memory_components",
+                      "_arm_memory_accounting"}
 
 SYNC_METHOD_ATTRS = {"item", "block_until_ready"}
 SYNC_FN_NAMES = {"device_get", "block_until_ready"}

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Peak-HBM regression guard — the memory twin of tools/comm_budget.py.
+
+Computes the analytic per-device peak bytes
+(runtime/memory_accounting.py — pure shape/mesh math, no devices,
+deterministic on any host) for a table of canonical configurations and
+compares each against the checked-in budget in
+``tools/memory_budgets.json``.  A config whose peak grew more than 10%
+over its budget FAILS: someone fattened a resident component (widened a
+dtype, unsharded an optimizer slot, grew the gather plan or the KV
+pool) without re-justifying the budget.
+
+Run directly, or via tests/unit/test_memory_budget.py so regressions
+fail the suite without a separate CI system (the comm_budget pattern).
+
+  python tools/mem_budget.py            # check against the budget table
+  python tools/mem_budget.py --update   # regenerate the budget table
+                                        # (sorted keys, atomic rewrite)
+
+Exit status 0 = within budget, 1 = violations (printed per config).
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from comm_budget import (GPT2ISH, MLP16, _leaves,  # noqa: E402
+                         check_budgets)
+from deepspeed_tpu.runtime import memory_accounting as ma  # noqa: E402
+from deepspeed_tpu.runtime.comm_accounting import zero_shard_dim  # noqa: E402
+from deepspeed_tpu.runtime.zero.stage3 import build_gather_plan  # noqa: E402
+
+BUDGET_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "memory_budgets.json")
+GROWTH_TOLERANCE = 0.10
+
+# serving pool shape for the gpt2-350m-ish decode config (block pool of
+# 8 slots x 64 blocks/seq + 1 trash block, 16-token blocks, 16 heads of
+# head_dim 64 over 24 layers — the bench decode geometry)
+_POOL = dict(n_layer=24, num_blocks=513, n_head=16, block_size=16,
+             head_dim=64)
+
+# zb-h1 stash-peak config: the schedule's peak live stash micros per
+# stage (bubble_accounting.simulate over the stash-compiled stream) x a
+# fixed per-micro residual scale of seq x hidden bf16 boundary
+# activations per layer of the stage.  The byte scale is a FLOOR model
+# (real residuals include pre-activations); what the budget gates is the
+# schedule side — peak_live_stash growing silently would breach it at
+# any scale.
+_STASH = dict(micro_batches=8, stages=4, seq=1024, hidden=1024,
+              layers_per_stage=6)
+
+CONFIGS = {
+    "gpt2-350m-ish/dp8/stage0/fp32": dict(
+        shapes=GPT2ISH, dp=8, zero_stage=0, compute_dtype="float32"),
+    "gpt2-350m-ish/dp8/stage1/bf16": dict(
+        shapes=GPT2ISH, dp=8, zero_stage=1, compute_dtype="bfloat16"),
+    "gpt2-350m-ish/dp8/stage2/bf16": dict(
+        shapes=GPT2ISH, dp=8, zero_stage=2, compute_dtype="bfloat16"),
+    "gpt2-350m-ish/dp8/stage2/bf16-qgz": dict(
+        shapes=GPT2ISH, dp=8, zero_stage=2, compute_dtype="bfloat16",
+        quantized_gradients=True),
+    "gpt2-350m-ish/dp8/stage2/bf16-offload": dict(
+        shapes=GPT2ISH, dp=8, zero_stage=2, compute_dtype="bfloat16",
+        cpu_offload=True),
+    # scheduled stage-3: params int8-gathered once per micro and live
+    # fwd->bwd — the transient is the gather plan's replicated footprint
+    # (what stage3_prefetch_budget bounds)
+    "gpt2-350m-ish/dp8/stage3/bf16-scheduled": dict(
+        shapes=GPT2ISH, dp=8, zero_stage=3, compute_dtype="bfloat16",
+        stage3_gathered=True),
+    "mlp16/dp8/stage2/fp32": dict(
+        shapes=MLP16, dp=8, zero_stage=2, compute_dtype="float32"),
+    # serving paged KV pools (per shard; params are budgeted by the
+    # training configs, the pool is the serving-only resident)
+    "serving/gpt2-350m-ish/decode-b8/pool-bf16": dict(
+        pool=dict(_POOL, kv_dtype="bfloat16", quantized=False)),
+    "serving/gpt2-350m-ish/decode-b8/pool-int8": dict(
+        pool=dict(_POOL, kv_dtype="bfloat16", quantized=True)),
+    # zb-h1 bounded stashing: worst-stage peak stash bytes (see _STASH)
+    "gpt2-350m-ish/pipe4/gas8/zb-stash-peak": dict(stash=_STASH),
+}
+
+
+def _stash_peak_bytes(cfg):
+    from deepspeed_tpu.runtime.pipe import bubble_accounting as ba
+    from deepspeed_tpu.runtime.pipe import schedule as sched_lib
+
+    compiled = sched_lib.compile_schedule(
+        sched_lib.SCHEDULE_ZB_H1, cfg["micro_batches"], cfg["stages"],
+        stash=True)
+    rep = ba.simulate(compiled)
+    per_micro = cfg["seq"] * cfg["hidden"] * 2 * cfg["layers_per_stage"]
+    peaks = [peak * per_micro for peak in rep["peak_live_stash"]]
+    return {
+        "peak_bytes": max(peaks),
+        "persistent_bytes": 0,
+        "transient_bytes": max(peaks),
+    }
+
+
+def compute_peaks():
+    """{config name: {peak/persistent/transient bytes per device}}."""
+    out = {}
+    for name, cfg in CONFIGS.items():
+        if "pool" in cfg:
+            pool = cfg["pool"]
+            bytes_ = ma.kv_pool_bytes(
+                pool["n_layer"], pool["num_blocks"], pool["n_head"],
+                pool["block_size"], pool["head_dim"],
+                kv_dtype=pool["kv_dtype"], quantized=pool["quantized"])
+            out[name] = {"peak_bytes": bytes_, "persistent_bytes": bytes_,
+                         "transient_bytes": 0}
+            continue
+        if "stash" in cfg:
+            out[name] = _stash_peak_bytes(cfg["stash"])
+            continue
+        dp = cfg["dp"]
+        leaves = _leaves(cfg["shapes"], dp)
+        gathered = 0
+        if cfg.get("stage3_gathered"):
+            plan = build_gather_plan(
+                [l.name for l in leaves], [l.shape for l in leaves],
+                [zero_shard_dim(l.shape, dp) for l in leaves], dp,
+                param_dtype=cfg["compute_dtype"])
+            gathered = plan.gathered_bytes
+        rep = ma.train_memory_report(
+            leaves, dp, zero_stage=cfg["zero_stage"],
+            compute_dtype=cfg["compute_dtype"],
+            cpu_offload=cfg.get("cpu_offload", False),
+            quantized_gradients=cfg.get("quantized_gradients", False),
+            gathered_stage3_bytes=gathered)
+        out[name] = {
+            "peak_bytes": rep["peak_bytes"],
+            "persistent_bytes": rep["persistent_bytes"],
+            "transient_bytes": rep["transient_bytes"],
+        }
+    return out
+
+
+def write_budgets(volumes, path):
+    """Deterministic regeneration: sorted keys, trailing newline, atomic
+    tmp+rename so a kill mid-write can never leave a torn table."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(volumes, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--update", action="store_true",
+                   help="regenerate tools/memory_budgets.json from "
+                        "current code (sorted keys, atomic rewrite)")
+    p.add_argument("--budget-file", default=BUDGET_PATH)
+    args = p.parse_args(argv)
+
+    peaks = compute_peaks()
+    if args.update:
+        write_budgets(peaks, args.budget_file)
+        print(f"wrote {args.budget_file} ({len(peaks)} configs)")
+        return 0
+
+    if not os.path.exists(args.budget_file):
+        print(f"FAIL: no budget table at {args.budget_file}; run "
+              f"--update and commit it")
+        return 1
+    with open(args.budget_file) as f:
+        budgets = json.load(f)
+    violations = check_budgets(peaks, budgets, tolerance=GROWTH_TOLERANCE)
+    if violations:
+        for name, key, actual, budget in violations:
+            if budget is None:
+                print(f"FAIL {name}: {key}")
+            else:
+                print(f"FAIL {name}: {key} = {actual} bytes exceeds "
+                      f"budget {budget} by "
+                      f"{100 * (actual / budget - 1):.1f}% "
+                      f"(>{100 * GROWTH_TOLERANCE:.0f}% allowed)")
+        print(f"{len(violations)} memory-budget violation(s). If the "
+              f"growth is intentional, run tools/mem_budget.py --update "
+              f"and justify the new budget in the PR.")
+        return 1
+    for name, vols in sorted(peaks.items()):
+        print(f"ok {name}: {vols['peak_bytes']} peak bytes/device")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

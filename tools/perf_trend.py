@@ -119,6 +119,13 @@ def trend_rows(rounds):
                     payload.get("detection_latency_steps"),
                 "corruption_recovered":
                     payload.get("corruption_recovered"),
+                # HBM watermark (ISSUE 15): rounds on backends without
+                # memory_stats (or before the probe landed) lack the
+                # keys and show as honest gaps — a None peak must never
+                # read as "fits in zero bytes"
+                "peak_hbm_bytes": payload.get("peak_hbm_bytes"),
+                "hbm_delta_vs_analytic":
+                    payload.get("hbm_delta_vs_analytic"),
                 "trace": tel.get("trace"),
                 "metrics_jsonl": tel.get("metrics_jsonl"),
             })
@@ -167,7 +174,8 @@ def trend_payload(pattern=DEFAULT_GLOB, root=".",
                     ("round", "ok", "value", "unit", "mfu", "step_ms",
                      "tokens_per_sec", "goodput_samples_per_wall_step",
                      "mttr_steps_mean", "detection_latency_steps",
-                     "corruption_recovered")} for r in rows],
+                     "corruption_recovered", "peak_hbm_bytes",
+                     "hbm_delta_vs_analytic")} for r in rows],
         "dead_rounds": [r["round"] for r in rows if not r["ok"]],
         "regression": check_regression(rows, threshold),
     }
@@ -201,15 +209,17 @@ def main(argv=None):
         print(json.dumps(summary, indent=1))
     else:
         print(f"{'round':>5} {'ok':>3} {'value':>10} {'mfu':>7} "
-              f"{'step_ms':>9} {'tok/s':>12} {'det.lat':>8} {'recov':>6}"
-              f"  metric")
+              f"{'step_ms':>9} {'tok/s':>12} {'det.lat':>8} {'recov':>6} "
+              f"{'hbm_GiB':>8}  metric")
         for r in rows:
+            hbm = r.get("peak_hbm_bytes")
             print(f"{r['round']:>5} {'y' if r['ok'] else 'n':>3} "
                   f"{_fmt(r.get('value')):>10} {_fmt(r.get('mfu'), 4):>7} "
                   f"{_fmt(r.get('step_ms'), 1):>9} "
                   f"{_fmt(r.get('tokens_per_sec'), 0):>12} "
                   f"{_fmt(r.get('detection_latency_steps'), 0):>8} "
-                  f"{_fmt(r.get('corruption_recovered')):>6}  "
+                  f"{_fmt(r.get('corruption_recovered')):>6} "
+                  f"{_fmt(hbm / 2**30 if hbm else None, 2):>8}  "
                   f"{(r.get('metric') or '-')[:60]}")
         if verdict["baseline"]:
             word = "REGRESSED" if verdict["regressed"] else "ok"

@@ -33,6 +33,19 @@ container budget, so no MFU trajectory was observable):
     died in compile/steps (not in backend init), so a budget-killed
     round still leaves its phase evidence behind and the next round
     reaches a perf number fast.
+
+Total-wall discipline (rounds 4/5 died rc=124 at phase=importing_jax:
+the container kill fired before ANY attempt timeout could — the
+default attempt budget was longer than the container's):
+  - --wall-budget-s (env BENCH_WALL_BUDGET_S, default 840) bounds the
+    WHOLE round; every import wait, attempt timeout and retry sleep is
+    clamped to the time actually left;
+  - the import clamp now covers every pre-ready phase (a worker wedged
+    at the backend probe used to wait forever) and stretches 2x per
+    respawn so a slow-but-healthy import eventually completes;
+  - SIGTERM (the outer `timeout` sends it before SIGKILL) and budget
+    exhaustion both route to the SAME structured failure JSON, so a
+    dead round always reports its phase evidence.
 """
 import argparse
 import hashlib
@@ -922,18 +935,24 @@ class _ServeWorker:
         for th in self._threads:
             th.join(timeout=10)
 
-    def wait_ready(self, import_timeout):
+    def wait_ready(self, import_timeout, probe_grace_s=120.0):
         """Block until the worker finished import + backend probe (phase
-        serve_ready), enforcing the import-phase budget; True on ready."""
+        serve_ready); True on ready.  The import budget bounds the
+        importing_jax phase, and ``probe_grace_s`` more bounds every
+        later pre-ready phase — r04/r05 regression: a worker wedged
+        AFTER the import (backend probe) used to wait forever, so the
+        round died to the outer container kill with no evidence."""
         while True:
             if any(name == "serve_ready" for name, _ in self.phases):
                 return True
             if not self.alive():
                 return False
+            elapsed = time.time() - self.t0
             still_importing = not self.phases or \
                 self.phases[-1][0] == "importing_jax"
-            elapsed = time.time() - self.t0
-            if still_importing and elapsed > import_timeout:
+            budget = import_timeout if still_importing \
+                else import_timeout + probe_grace_s
+            if elapsed > budget:
                 self.kill()
                 return False
             time.sleep(0.25)
@@ -1039,7 +1058,34 @@ def _run_chaos_rung(worker, args, payload, record):
             payload[stanza] = {"error": str(e)}
 
 
+class _WallBudgetKill(BaseException):
+    """Raised by the SIGTERM handler / wall-budget checks: the round is
+    out of time and must emit its structured failure JSON NOW, before
+    the container's SIGKILL follow-up lands."""
+
+
 def run_parent(args) -> int:
+    # total-wall discipline (r04/r05 lesson): the container kills the
+    # whole driver at ~870 s, which is SHORTER than one default attempt
+    # timeout (1500 s) — so a wedged first rung used to die rc=124 with
+    # no JSON and no phase evidence.  Every wait below is clamped to the
+    # time actually left, and SIGTERM (the outer `timeout` sends it
+    # before SIGKILL) converts to a structured failure line.
+    import signal
+
+    wall_deadline = time.time() + args.wall_budget_s
+
+    def remaining():
+        return wall_deadline - time.time()
+
+    def _on_term(signum, frame):
+        raise _WallBudgetKill(f"signal {signum}")
+
+    try:
+        old_term = signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:          # non-main thread (tests): skip the hook
+        old_term = None
+
     # attempt ladder: requested config first (round-4 tuned: batch 48 +
     # chunked LM head reached 60.2 TFLOPS/chip, 0.94 vs baseline, on a
     # v5e), then progressively smaller / faster-compiling fallbacks
@@ -1117,10 +1163,17 @@ def run_parent(args) -> int:
 
     errors = []
     worker = None
+    wall_killed = False
     try:
         for ai, spec in enumerate(attempts):
             init_retries = args.init_retries
+            import_stretch = 1
             while True:
+                if remaining() < 60:
+                    # not enough wall left for any useful attempt — stop
+                    # NOW and leave the structured failure line instead
+                    # of letting the container kill swallow the round
+                    raise _WallBudgetKill("wall budget exhausted")
                 # ONE worker serves every rung: import + backend-up are
                 # paid once per round (the phases rounds 2/4/5 died in),
                 # and only a hang/death forces a respawn
@@ -1129,12 +1182,16 @@ def run_parent(args) -> int:
                         worker.kill()
                     worker = _ServeWorker(args, env)
                     import_budget = min(args.import_budget_s,
-                                        spec["timeout"])
+                                        spec["timeout"]) * import_stretch
                     if known_import_s:
                         # prior rounds measured the real import cost;
                         # don't kill a healthy-but-slow import under it
                         import_budget = max(import_budget,
                                             int(known_import_s * 2))
+                    # never grant the import more wall than the round
+                    # actually has left (minus room for the evidence)
+                    import_budget = min(import_budget,
+                                        max(60, int(remaining() - 45)))
                     if not worker.wait_ready(import_budget):
                         elapsed = round(time.time() - worker.t0, 1)
                         last = worker.phases[-1][0] if worker.phases \
@@ -1155,9 +1212,15 @@ def run_parent(args) -> int:
                               flush=True)
                         worker.kill()
                         worker = None
-                        if init_retries > 0:
+                        if init_retries > 0 and remaining() > 120:
                             init_retries -= 1
-                            time.sleep(args.retry_wait_s)
+                            # stretch-on-retry: a healthy-but-slow
+                            # import (wedged tunnel easing off) gets a
+                            # doubled budget next spawn instead of dying
+                            # to the same clamp again
+                            import_stretch = min(import_stretch * 2, 4)
+                            time.sleep(min(args.retry_wait_s,
+                                           max(1, remaining() - 90)))
                             continue
                         break
                     ready_at = dict(worker.phases).get("serve_ready")
@@ -1168,7 +1231,8 @@ def run_parent(args) -> int:
                 ckey = _cfg_hash(spec, args)
                 t0 = time.time()
                 rc, stdout, stderr, phases, timed_out = worker.run(
-                    spec, args, spec["timeout"])
+                    spec, args,
+                    min(spec["timeout"], max(30, int(remaining() - 30))))
                 elapsed = round(time.time() - t0, 1)
                 timings = _phase_timings(phases, elapsed)
                 last_phase = phases[-1][0] if phases else "dispatch"
@@ -1237,14 +1301,31 @@ def run_parent(args) -> int:
                 print(f"[bench] attempt {ai} ({spec['model']}) failed at "
                       f"phase={last_phase} timed_out={timed_out}",
                       file=sys.stderr, flush=True)
-                if backend_issue and init_retries > 0:
+                if backend_issue and init_retries > 0 \
+                        and remaining() > 120:
                     init_retries -= 1
-                    time.sleep(args.retry_wait_s)
+                    time.sleep(min(args.retry_wait_s,
+                                   max(1, remaining() - 90)))
                     continue  # same attempt: transient tunnel flake (the
                     # warm worker retries without re-importing; only a
                     # dead worker pays a respawn)
                 break  # fall through to the next (smaller) attempt
+    except _WallBudgetKill as e:
+        # the round is out of wall (our own budget check or the
+        # container's SIGTERM): leave the evidence — phase cache entry
+        # plus the structured failure line — before the SIGKILL lands
+        wall_killed = True
+        last = (worker.phases[-1][0]
+                if worker is not None and worker.phases else "spawn")
+        errors.append({"wall_killed": True, "reason": str(e),
+                       "last_phase": last,
+                       "remaining_s": round(remaining(), 1)})
+        _record("__env__", wall_killed=True, last_phase=last)
+        print(f"[bench] wall budget exhausted ({e}) at phase={last}",
+              file=sys.stderr, flush=True)
     finally:
+        if old_term is not None:
+            signal.signal(signal.SIGTERM, old_term)
         if worker is not None:
             worker.kill()
 
@@ -1254,6 +1335,8 @@ def run_parent(args) -> int:
         "unit": "TFLOPS/chip",
         "vs_baseline": 0.0,
         "error": "all bench attempts failed",
+        "wall_killed": wall_killed,
+        "wall_budget_s": args.wall_budget_s,
         "attempts": errors,
     }), flush=True)
     return 1
@@ -1294,6 +1377,15 @@ def main():
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--budget_s", type=int, default=1500,
                    help="wall-clock budget for the primary attempt")
+    p.add_argument("--wall-budget-s", dest="wall_budget_s", type=int,
+                   default=int(os.environ.get("BENCH_WALL_BUDGET_S",
+                                              "840")),
+                   help="TOTAL wall budget for the whole round (env "
+                        "BENCH_WALL_BUDGET_S) — r04/r05: the container "
+                        "kills the driver at ~870 s, shorter than one "
+                        "default attempt timeout, so every wait is "
+                        "clamped to the time left and the structured "
+                        "failure JSON always lands before the kill")
     p.add_argument("--import-budget-s", type=int, default=300,
                    help="budget for the jax-import phase alone (r05: a "
                         "wedged tunnel during import ate the whole compile "

@@ -70,10 +70,10 @@ class TrainState(NamedTuple):
 
 
 class DeepSpeedEngine:
-    # subclasses whose step path cannot drive the numerical-integrity
-    # defense (ISSUE 13) override this to False — _arm_integrity then
-    # DISARM-warns instead of arming a monitor nothing would feed
-    # (a class flag, not a name check, so SUBCLASSES inherit the block)
+    # subclasses whose state layout cannot support the cross-replica
+    # integrity vote (ISSUE 13) override this to False — _arm_integrity
+    # then arms sentinels-only and DISARM-warns the vote (a class flag,
+    # not a name check, so SUBCLASSES inherit the block)
     _integrity_armable = True
 
     def __init__(self, args=None, model=None, optimizer=None,
@@ -2848,6 +2848,21 @@ class DeepSpeedEngine:
             {"overflow": not finite,
              "grad_norm": getattr(self, "_last_grad_norm", 0.0),
              "loss_scale": scale})
+        mon = self._integrity
+        if mon is not None and mon.sentinels_armed:
+            # sentinels ride the offload step's HOST values: the grad
+            # norm was just computed on host for clipping, overflow is
+            # the host finite check — the loss is the one scalar fetch,
+            # on a path that already streams every gradient through
+            # host memory (update_ratio stays None: the host kernel
+            # updates masters in place, a before/after norm would add
+            # a full extra pass over the master shards)
+            observe_loss = None if self._pending_loss is None else \
+                float(jax.device_get(self._pending_loss))
+            mon.observe_step(self.global_steps, loss=observe_loss,
+                             grad_norm=self._last_grad_norm if finite
+                             else None,
+                             update_ratio=None, overflow=not finite)
         self._observe_step_outcome(loss=self._pending_loss,
                                    overflow=not finite)
         if self.global_steps % self.steps_per_print() == 0:
@@ -3214,7 +3229,9 @@ class DeepSpeedEngine:
         blocker.  Armed engines compute the step sentinels (loss, global
         grad norm, update/param-norm ratio) INSIDE the step jits and
         fetch them with the existing one-per-step batched device read —
-        no new host syncs; the cross-replica vote / duplicate-compute
+        no new host syncs; host-stepped paths (ZeRO-Offload, the pipe
+        interpreter) feed the loss/grad-norm values they already hold on
+        host instead; the cross-replica vote / duplicate-compute
         jits compile lazily on their cadence, never on the step path.
         Disarmed engines hold ``self._integrity = None``: the compiled
         step programs are UNTOUCHED (bit-identical, zero extra compiles
@@ -3227,17 +3244,6 @@ class DeepSpeedEngine:
             IntegrityConfig, IntegrityMonitor)
 
         blockers = []
-        if not self._integrity_armable:
-            blockers.append(
-                "PipelineEngine (per-stage params have no cross-stage "
-                "'data' replica to vote over, and the pipe interpreter's "
-                "stat fetch predates the sentinel plumbing)")
-        if self._offload:
-            blockers.append(
-                "cpu_offload=true (the optimizer steps on HOST master "
-                "shards — there is no device-resident replicated state "
-                "for the vote, and the sentinel norms would add host "
-                "passes to the streaming grad path)")
         if self._onebit_wire():
             blockers.append(
                 "1-bit Adam wire compression (the shard_map'd update "
@@ -3254,16 +3260,23 @@ class DeepSpeedEngine:
         cfg = IntegrityConfig.from_resilience(res)
         dp = self.dp_world_size
         vote_armed = True
+        vote_gathered = False
         vote_blockers = []
         if dp <= 1:
             vote_blockers.append(
                 "dp=1 (a single replica has nobody to disagree with)")
-        if self.zero_optimization_stage() >= 3:
+        if not self._integrity_armable:
             vote_blockers.append(
-                "zero stage 3 (params are ZeRO-sharded at rest — no "
-                "replicated redundancy; sharded-state corruption "
-                "propagates symmetrically and is caught by the "
-                "sentinels instead)")
+                "PipelineEngine (per-stage params have no cross-stage "
+                "'data' replica to vote over; sentinels ride the host "
+                "loss/grad-norm the pipe interpreter already fetches)")
+        if self._offload:
+            vote_blockers.append(
+                "cpu_offload=true (the optimizer steps on HOST master "
+                "shards and re-pushes device params every step — a "
+                "device vote would checksum state the next push "
+                "overwrites; sentinels ride the host grad-norm/loss "
+                "the streaming path already computes)")
         if vote_blockers:
             vote_armed = False
             log_dist(
@@ -3271,14 +3284,29 @@ class DeepSpeedEngine:
                 f"{'; '.join(vote_blockers)}; sentinels-only (anomalies "
                 f"roll back without a culprit rank)",
                 ranks=[0], level=logging.WARNING)
-        dup_armed = vote_armed and cfg.dup_check_every_steps > 0
+        elif self.zero_optimization_stage() >= 3:
+            # stage 3: params are ZeRO-sharded at rest, so the vote
+            # all_gather-assembles them inside the cadence jit and each
+            # rank folds its OWN assembled copy — asymmetric gather/
+            # assembly divergence splits the digest table (the mode a
+            # stage-3 forward feeds straight into the matmuls); a shard
+            # corrupted at rest assembles identically everywhere and
+            # stays the sentinels' case
+            vote_gathered = True
+        # the dup check replays one micro with REPLICATED params; under
+        # stage 3 the param in_specs are 'data'-sharded, so the replayed
+        # loss would see shard-shaped weights — gathered mode keeps it off
+        dup_armed = vote_armed and not vote_gathered \
+            and cfg.dup_check_every_steps > 0
         self._integrity = IntegrityMonitor(
             cfg, dp, sentinels_armed=True, vote_armed=vote_armed,
-            dup_armed=dup_armed, tracer=self._tracer)
+            dup_armed=dup_armed, vote_gathered=vote_gathered,
+            tracer=self._tracer)
         log_dist(
             f"numerical-integrity defense armed: sentinels "
             f"(z>{cfg.z_threshold:g} over a {cfg.window}-step window), "
-            f"cross-replica vote={'on' if vote_armed else 'off'}, "
+            f"cross-replica vote="
+            f"{('on (gathered)' if vote_gathered else 'on') if vote_armed else 'off'}, "
             f"duplicate-compute check="
             f"{'every %d steps' % cfg.dup_check_every_steps if dup_armed else 'off'}",
             ranks=[0])

@@ -111,10 +111,11 @@ class _MfuJitProxy:
 
 
 class PipelineEngine(DeepSpeedEngine):
-    # the pipe interpreter's stat fetch predates the integrity sentinel
-    # plumbing and per-stage params have no cross-stage 'data' replica
-    # to vote over — _arm_integrity DISARM-warns (ISSUE 13); inherited
-    # by any PipelineEngine subclass, unlike a class-name check
+    # per-stage params have no cross-stage 'data' replica to vote over —
+    # _arm_integrity keeps the SENTINELS armed (they ride the host
+    # loss/grad-norm this interpreter already fetches) and DISARM-warns
+    # only the vote (ISSUE 13/16); inherited by any PipelineEngine
+    # subclass, unlike a class-name check
     _integrity_armable = False
     """Training engine for PipelineModule models. Use train_batch/eval_batch;
     forward/backward/step are disabled (reference pipe/engine.py:1090-1098)."""
@@ -985,6 +986,16 @@ class PipelineEngine(DeepSpeedEngine):
             "loss_scale": scale, "loss": loss,
             "pipe_schedule": self.pipe_schedule,
             "pipe_p2p_bytes_per_step": self._last_p2p_bytes}
+        mon = self._integrity
+        if mon is not None and mon.sentinels_armed:
+            # sentinels ride the values this interpreter ALREADY holds
+            # on host — the batched sqnorm fetch above and the one loss
+            # reduction: zero new device syncs (update_ratio stays
+            # None; per-stage apply jits have no delta-norm outputs)
+            mon.observe_step(self.global_steps, loss=loss,
+                             grad_norm=float(self._last_grad_norm)
+                             if all_finite else None,
+                             update_ratio=None, overflow=not all_finite)
         self._observe_step_outcome(loss=loss, overflow=not all_finite)
         if self.global_steps % self.steps_per_print() == 0:
             self._report_progress(self.global_steps)

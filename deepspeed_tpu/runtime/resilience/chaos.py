@@ -34,11 +34,14 @@ class ChaosPlan:
                  kill_ranks=(), fail_step_transient=0,
                  fail_step_transient_count=1, silence_heartbeat=None,
                  kill_once_at_point=None, flip_bits=(),
-                 spike_loss_at_step=0, spike_loss_magnitude=64.0):
+                 spike_loss_at_step=0, spike_loss_magnitude=64.0,
+                 kill_process_ranks=()):
         self.kill_after_files = kill_after_files
         self.kill_at_point = kill_at_point
         self.kill_once_at_point = kill_once_at_point
         self.kill_ranks = tuple(tuple(p) for p in (kill_ranks or ()))
+        self.kill_process_ranks = [tuple(p)
+                                   for p in (kill_process_ranks or ())]
         self.fail_step_transient = fail_step_transient
         self.fail_step_transient_count = fail_step_transient_count
         self.silence_heartbeat = tuple(silence_heartbeat) \
@@ -155,6 +158,13 @@ def arm(**kwargs):
                          loss spike: the batch feeding step N is scaled
                          by M (anomalous data, symmetric across ranks —
                          rollback-and-skip territory, not quarantine).
+    kill_process_ranks=((R, N), ...)  SIGKILL the REAL worker process
+                         behind transport peer R at wall step N (the
+                         ProcessTransport heartbeat tick consults this
+                         and delivers kill(2) for real — nothing
+                         simulated about the death or the verdict that
+                         follows; the in-process transport's analog is
+                         kill_ranks).  Each pair fires once.
     """
     global _plan
     _plan = ChaosPlan(**kwargs)
@@ -254,6 +264,26 @@ def rank_dead(rank, step_index):
             _notify("kill_rank", rank)
             return True
     return False
+
+
+def process_kill_due(rank, step_index):
+    """One-shot query: True when an armed ``kill_process_ranks`` plan
+    wants transport peer ``rank``'s REAL process SIGKILLed at/after
+    wall step ``step_index``.  Consumes the pair — the kill itself is
+    permanent (a killed process stays dead without chaos re-firing),
+    so unlike ``rank_dead`` this is not re-queried every tick."""
+    if _plan is None or not _plan.kill_process_ranks:
+        return False
+    with _plan._lock:
+        for i, (r, s) in enumerate(_plan.kill_process_ranks):
+            if r == rank and step_index >= s:
+                del _plan.kill_process_ranks[i]
+                _plan.fired.append(("kill_process", (r, s)))
+                break
+        else:
+            return False
+    _notify("kill_process", rank)
+    return True
 
 
 def heartbeat_silenced(rank, step_index):

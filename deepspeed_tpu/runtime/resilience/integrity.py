@@ -215,6 +215,70 @@ def build_vote_jit(engine, specs):
         axis_names={"data"}, check_vma=False))
 
 
+def gathered_vote_leaves(engine):
+    """Stage-3 vote census: the replicated leaves (folded locally, same
+    as :func:`replicated_vote_leaves`) PLUS the ZeRO-sharded PARAM
+    leaves, which each rank will all_gather-assemble inside the vote jit
+    and fold its OWN assembled copy of.  Returns ``(leaves, in_specs,
+    names, gather_flags)``.
+
+    What the gathered digest can and cannot see: every rank folds the
+    same logical array, so a shard corrupted AT REST assembles
+    identically everywhere — unanimous digests, invisible here (the
+    sentinels own that case, exactly as the stage-2 exclusion argued).
+    What DOES split the table is asymmetric divergence on the gather
+    path itself — a rank whose interconnect/HBM read corrupts during
+    assembly folds different bits than its peers, which is the
+    corruption mode a stage-3 forward gather feeds straight into the
+    matmuls.  Sharded optimizer moments stay excluded (same rationale,
+    4x the gathered bytes for no added coverage)."""
+    import jax
+
+    leaves, specs, names = replicated_vote_leaves(engine)
+    gather_flags = [False] * len(leaves)
+    flat, _ = jax.tree_util.tree_flatten_with_path(engine.state.params)
+    sh_flat = jax.tree_util.tree_leaves(engine._shardings.params)
+    assert len(flat) == len(sh_flat)
+    for (path, leaf), sharding in zip(flat, sh_flat):
+        if not _spec_has_data(sharding.spec):
+            continue  # replicated params are already in the local set
+        leaves.append(leaf)
+        specs.append(_manual_only_spec(sharding))
+        names.append("params" + jax.tree_util.keystr(path) + " [gathered]")
+        gather_flags.append(True)
+    return leaves, specs, names, gather_flags
+
+
+def build_gathered_vote_jit(engine, specs, gather_flags):
+    """Stage-3 variant of :func:`build_vote_jit`: sharded param leaves
+    are ``all_gather``-assembled over 'data' INSIDE the shard_map, then
+    every rank XOR-folds the copy it assembled — per-rank digests of the
+    full weights, agreed by the same trailing digest all_gather.  The
+    assembly transient peaks at one full leaf per gather (the same
+    working set a stage-3 forward gather holds), which is why this jit
+    lives on the cadence path and never on the step path.  Entered
+    uniformly by every rank (rank-branch-collective clean)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = engine.mesh
+    flags = tuple(bool(f) for f in gather_flags)
+
+    def vote(leaves):
+        folded = []
+        for leaf, gathered in zip(leaves, flags):
+            if gathered:
+                leaf = jax.lax.all_gather(leaf, "data")
+            folded.append(_fold_words(leaf))
+        digest = jnp.stack(folded)
+        return jax.lax.all_gather(digest, "data")
+
+    return jax.jit(jax.shard_map(
+        vote, mesh=mesh, in_specs=(tuple(specs),), out_specs=P(),
+        axis_names={"data"}, check_vma=False))
+
+
 def state_vote(engine):
     """Run the cross-replica state vote; returns the classification dict
     of :func:`classify_digests` plus the raw digest table.  ONE
@@ -231,10 +295,17 @@ def state_vote(engine):
 
     mon = engine._integrity
     if mon._vote_jit is None:
-        leaves, specs, names = replicated_vote_leaves(engine)
+        if mon.vote_gathered:
+            leaves, specs, names, flags = gathered_vote_leaves(engine)
+            mon._vote_jit = build_gathered_vote_jit(engine, specs, flags)
+        else:
+            leaves, specs, names = replicated_vote_leaves(engine)
+            mon._vote_jit = build_vote_jit(engine, specs)
         mon._vote_leaf_names = names
-        mon._vote_jit = build_vote_jit(engine, specs)
-    leaves, _specs, _names = replicated_vote_leaves(engine)
+    if mon.vote_gathered:
+        leaves = gathered_vote_leaves(engine)[0]
+    else:
+        leaves, _specs, _names = replicated_vote_leaves(engine)
     with jax.set_mesh(engine.mesh):
         table = mon._vote_jit(tuple(leaves))
     rows = np.asarray(jax.device_get(table), dtype=np.int64)
@@ -475,12 +546,13 @@ class IntegrityMonitor:
     # constructor just records the outcome
     # graftlint: disable=disarmed-discipline
     def __init__(self, config, dp, sentinels_armed=True, vote_armed=True,
-                 dup_armed=False, tracer=None):
+                 dup_armed=False, vote_gathered=False, tracer=None):
         self.config = config
         self.dp = int(dp)
         self.sentinels_armed = bool(sentinels_armed)
         self.vote_armed = bool(vote_armed)
         self.dup_armed = bool(dup_armed)
+        self.vote_gathered = bool(vote_gathered)
         self.stats = {n: SentinelStat(config.window)
                       for n in SENTINEL_NAMES}
         self.anomaly_step = None      # first anomalous step of open window
@@ -680,6 +752,8 @@ class IntegrityMonitor:
             "armed": True,
             "sentinels_armed": self.sentinels_armed,
             "vote_armed": self.vote_armed,
+            "vote_mode": ("gathered" if self.vote_gathered
+                          else "replicated") if self.vote_armed else None,
             "dup_check_armed": self.dup_armed,
             "dp": self.dp,
             "anomalies": self.anomalies,

@@ -686,16 +686,18 @@ class InferenceEngine:
         memory-bound, and moving the finished prompt's KV blocks once
         is what makes separately-provisioned replicas composable.  The
         payload is priced analytically by
-        ``comm_accounting.serving_kv_handoff_collectives``."""
-        assert self.shards == 1, \
-            "KV handoff exports a host copy of the page view; sharded " \
-            "pools hand off per-shard (not yet wired) — use shards=1 " \
-            "replicas in role-split fleets"
+        ``comm_accounting.serving_kv_handoff_collectives``.
+
+        Sharded pools (``shards > 1``) hand off through the same path:
+        the gather addresses GLOBAL block ids (local + the owning
+        shard's base — ``pool.global_table_row``), so the host copy is
+        shard-layout-free and imports into a destination with ANY shard
+        count."""
         req = self.scheduler.requests.get(rid)
         assert req is not None and req.state is RequestState.RUNNING, \
             f"export_request({rid}): not a RUNNING request"
         assert req.generated, "RUNNING request with no first token"
-        row = self.pool.table_row(rid, self.W)
+        row = self.pool.global_table_row(rid, self.W)
         n_blocks = len(self.pool._blocks[rid])
         n_positions = self.pool._positions[rid]
         # one fixed-shape gather + ONE batched fetch: (L, W, H, bs, D)
@@ -733,7 +735,6 @@ class InferenceEngine:
         prefill.  Deadlines restart relative (the :meth:`recover`
         semantics — clocks do not cross replicas); work budgets carry
         over.  Returns ``"adopted"`` or ``"requeued"``."""
-        assert self.shards == 1, "see export_request"
         rid = int(entry["rid"])
         assert rid not in self.scheduler.requests, \
             f"import_request({rid}): rid already live here"
@@ -767,13 +768,26 @@ class InferenceEngine:
             req.deadline = self.clock() + float(req.deadline_s)
         ok = self.pool.alloc(rid, shard, entry["n_positions"])
         assert ok, "free_blocks precheck lied"
-        dst_row = self.pool.table_row(rid, self.W)
+        # the scatter addresses GLOBAL rows (trash padding lands in the
+        # adopting shard's own trash block); the decode table stays
+        # LOCAL — inside the sharded decode shard_map each shard sees
+        # only its local block range
+        dst_row = self.pool.global_table_row(rid, self.W)
         t = self.pool.tensors.arrays
-        self._rebind(tuple(
-            a.at[:, dst_row].set(jnp.asarray(part))
-            for a, part in zip(t, entry["kv"])))
+        new = tuple(a.at[:, dst_row].set(jnp.asarray(part))
+                    for a, part in zip(t, entry["kv"]))
+        if self.shards > 1 and self.pool.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            # the out-of-jit scatter may resolve to a different layout;
+            # pin the pool's (None, 'data') block-axis split back so the
+            # donated decode jit sees its expected input sharding
+            spec = NamedSharding(self.pool.mesh,
+                                 P(None, self.pool.axis_name))
+            new = tuple(jax.device_put(x, spec) for x in new)
+        self._rebind(new)
         self.scheduler.adopt_running(req, slot)
-        self._tables[slot] = dst_row
+        self._tables[slot] = self.pool.table_row(rid, self.W)
         self._pos[slot] = len(req.full_tokens) - 1
         self._tok[slot] = req.generated[-1]
         self._seeds[slot] = req.seed

@@ -90,11 +90,36 @@ class FleetConfig:
     replica is retried before it is declared dead: strike k backs off
     ``retry_backoff_steps * k`` router steps, and
     ``max_consecutive_failures`` strikes with no clean step between
-    them trip the breaker."""
+    them trip the breaker.  ``transport_timeout_steps`` is the
+    step-clock heartbeat window for a transport-backed fleet (ISSUE
+    16): a peer silent past it is voted on and — agreed — marked dead
+    through the same breaker/migration path a crash takes."""
     dispatch: str = "slo"                 # "slo" | "round-robin"
     max_consecutive_failures: int = 3
     retry_backoff_steps: int = 2
     stall_timeout_s: float = 0.0          # per-replica stall detector
+    transport_timeout_steps: int = 3
+
+
+@dataclass(frozen=True)
+class AutoscaleConfig:
+    """Telemetry-driven replica-set sizing (ISSUE 16).  The signals are
+    the unified metrics the router already computes every step: queue
+    depth per active replica (waiting + running, the load the fleet is
+    actually carrying) and — optionally — the worst predicted TTFT
+    across replicas (the same estimator SLO dispatch uses;
+    ``scale_up_ttft_s=0`` disables that trigger).  ``cooldown_steps``
+    ticks must pass after any scale event before the next one, so a
+    burst cannot thrash the set; scale-down is a graceful
+    ``drain_replica`` (a death you scheduled — journal-backed, zero
+    lost requests), never a kill."""
+    min_replicas: int = 1
+    max_replicas: int = 4
+    scale_up_queue_depth: float = 4.0     # waiting+running per replica
+    scale_down_queue_depth: float = 1.0
+    scale_up_ttft_s: float = 0.0          # 0 = queue-depth trigger only
+    cooldown_steps: int = 8
+    evaluate_every: int = 1
 
 
 class ReplicaHandle:
@@ -132,7 +157,8 @@ class FleetRouter:
 
     def __init__(self, model, params, *, replicas=2, roles=None,
                  clock=time.monotonic, config=None, reliability=None,
-                 journal_dir=None, engine_kwargs=None, telemetry=None):
+                 journal_dir=None, engine_kwargs=None, telemetry=None,
+                 autoscale=None, transport=None):
         assert replicas >= 1
         cfg = config if isinstance(config, FleetConfig) \
             else FleetConfig(**(config or {}))
@@ -148,29 +174,17 @@ class FleetRouter:
             assert any(r in (ROLE_BOTH, ROLE_DECODE) for r in roles), \
                 "role-split fleet needs a decode-capable replica"
         self._role_split = any(r == ROLE_PREFILL for r in roles)
-        ekw = dict(engine_kwargs or {})
+        # retained for autoscale scale-up: a grown replica is built from
+        # the SAME spec as the founding set (and shares the lru-cached
+        # compiled programs, so growing costs no recompile)
+        self._model = model
+        self._params = params
+        self._engine_kwargs = dict(engine_kwargs or {})
+        self._reliability_spec = dict(reliability or {})
+        self._journal_dir = journal_dir
         self.replicas: List[ReplicaHandle] = []
         for i in range(replicas):
-            rel = dict(reliability or {})
-            jpath = None
-            if journal_dir is not None:
-                import os
-
-                os.makedirs(str(journal_dir), exist_ok=True)
-                jpath = os.path.join(str(journal_dir),
-                                     f"replica{i}.jsonl")
-                rel["journal_path"] = jpath
-            wd = None
-            if cfg.stall_timeout_s > 0:
-                wd = TrainingWatchdog(stall_timeout=cfg.stall_timeout_s)
-            eng = InferenceEngine(model, params, clock=clock,
-                                  reliability=rel or None, watchdog=wd,
-                                  **ekw)
-            eng._replica_index = i
-            rep = ReplicaHandle(i, eng, roles[i], jpath)
-            if wd is not None:
-                wd.add_callback(self._stall_cb(rep))
-            self.replicas.append(rep)
+            self.replicas.append(self._new_replica(i, roles[i]))
         self._rids = itertools.count()
         self._owner: Dict[int, int] = {}      # rid -> replica index
         self._router_results: Dict[int, dict] = {}   # lost requests
@@ -180,8 +194,38 @@ class FleetRouter:
         self.handoffs: List[dict] = []
         self.handoff_bytes = 0
         self.lost: List[int] = []
+        self.replica_steps = 0      # sum of alive replicas over steps:
+        #                             the honest autoscale denominator
         self._arm_dispatch()
         self._arm_telemetry(telemetry)
+        self._arm_autoscale(autoscale)
+        self._arm_transport(transport)
+
+    def _new_replica(self, i, role):
+        """Build one replica handle from the retained fleet spec — the
+        shared constructor of the founding set and every autoscale
+        grow."""
+        rel = dict(self._reliability_spec)
+        jpath = None
+        if self._journal_dir is not None:
+            import os
+
+            os.makedirs(str(self._journal_dir), exist_ok=True)
+            jpath = os.path.join(str(self._journal_dir),
+                                 f"replica{i}.jsonl")
+            rel["journal_path"] = jpath
+        wd = None
+        if self.config.stall_timeout_s > 0:
+            wd = TrainingWatchdog(
+                stall_timeout=self.config.stall_timeout_s)
+        eng = InferenceEngine(self._model, self._params, clock=self.clock,
+                              reliability=rel or None, watchdog=wd,
+                              **self._engine_kwargs)
+        eng._replica_index = i
+        rep = ReplicaHandle(i, eng, role, jpath)
+        if wd is not None:
+            wd.add_callback(self._stall_cb(rep))
+        return rep
 
     @staticmethod
     def _stall_cb(rep):
@@ -259,6 +303,79 @@ class FleetRouter:
                 rt._telemetry_chaos_cb(kind, detail)
 
         self._chaos_observer = chaos.add_observer(_chaos_obs)
+
+    def _arm_autoscale(self, spec):
+        """Arm telemetry-driven autoscaling (ISSUE 16), or warn loudly
+        (DISARMED) naming every blocker and keep the replica set fixed.
+        Blockers: a role-split fleet (growing a replica means choosing
+        its prefill/decode role — a placement policy this autoscaler
+        does not make), invalid bounds, and — when the predicted-TTFT
+        trigger is requested — any replica the estimator cannot
+        describe."""
+        self.autoscale_armed = False
+        self._autoscale = None
+        self.scale_events: List[dict] = []
+        self._scale_cooldown_until = 0
+        if spec is None:
+            return
+        cfg = spec if isinstance(spec, AutoscaleConfig) \
+            else AutoscaleConfig(**spec)
+        blockers = []
+        if self._role_split:
+            blockers.append(
+                "the fleet is role-split (a grown replica needs a "
+                "prefill/decode placement decision this autoscaler "
+                "does not make)")
+        if cfg.min_replicas < 1 or cfg.max_replicas < cfg.min_replicas:
+            blockers.append(
+                f"invalid replica bounds "
+                f"[{cfg.min_replicas}, {cfg.max_replicas}]")
+        if cfg.scale_up_ttft_s > 0:
+            blockers.extend(
+                f"replica {r.index} runs the "
+                f"'{r.engine.scheduler.policy}' scheduler policy (the "
+                f"predicted-TTFT trigger only describes 'continuous')"
+                for r in self.replicas
+                if r.engine.scheduler.policy != "continuous")
+        if blockers:
+            logger.warning(
+                "fleet autoscaler: DISARMED — %s; the replica set stays "
+                "fixed at %d.", "; ".join(blockers), len(self.replicas))
+            return
+        self._autoscale = cfg
+        self.autoscale_armed = True
+
+    def _arm_transport(self, transport):
+        """Arm the cross-process peer bus (ISSUE 16 transport seam):
+        replica ``i``'s host liveness rides transport peer ``i+1``
+        (rank 0 is the router).  Armed, a peer silent past
+        ``transport_timeout_steps`` router ticks is voted on and —
+        agreed — its replica takes the breaker/migration path a crash
+        takes.  Blockers warn DISARMED and leave replica liveness
+        in-process (engine watchdog + chaos only): a world that does
+        not map onto the replica set, or an armed autoscaler (a grown
+        replica would have no transport peer)."""
+        self._transport = None
+        self.transport_armed = False
+        if transport is None:
+            return
+        blockers = []
+        if transport.world != len(self.replicas) + 1:
+            blockers.append(
+                f"transport world {transport.world} does not map onto "
+                f"{len(self.replicas)} replicas + 1 router (peer rank "
+                f"i+1 <-> replica i)")
+        if self.autoscale_armed:
+            blockers.append(
+                "autoscaling is armed (a grown replica would have no "
+                "transport peer; grow the transport world first)")
+        if blockers:
+            logger.warning(
+                "fleet transport: DISARMED — %s; replica liveness stays "
+                "in-process (watchdog/chaos only).", "; ".join(blockers))
+            return
+        self._transport = transport.start()
+        self.transport_armed = True
 
     def _telemetry_chaos_cb(self, kind, detail=None):
         tr = self._tracer
@@ -359,9 +476,14 @@ class FleetRouter:
         tr = self._tracer
         _t0 = tr.begin() if tr is not None else 0.0
         events = {"failures": [], "dead": [], "drained": [],
-                  "migrated": [], "handoffs": []}
+                  "migrated": [], "handoffs": [], "scaled": []}
+        if self._transport is not None:
+            self._transport_tick(events)
         for rep in self.replicas:
             self._step_replica(rep, events)
+        if self.autoscale_armed:
+            self._autoscale_tick(events)
+        self.replica_steps += sum(1 for r in self.replicas if r.alive)
         self._last_metrics = {
             "step": self._step_idx,
             "alive": sum(1 for r in self.replicas if r.alive),
@@ -371,6 +493,8 @@ class FleetRouter:
             "handoffs": len(self.handoffs),
             "handoff_bytes": self.handoff_bytes,
             "lost": len(self.lost),
+            "replica_steps": self.replica_steps,
+            "scale_events": len(self.scale_events),
         }
         if tr is not None:
             tr.complete("router_step", self._lane_router, _t0,
@@ -485,6 +609,9 @@ class FleetRouter:
         self.handoffs = []
         self.handoff_bytes = 0
         self.lost = []
+        self.replica_steps = 0
+        self.scale_events = []
+        self._scale_cooldown_until = 0
         for rep in self.replicas:
             rep.placed = 0
 
@@ -501,6 +628,123 @@ class FleetRouter:
             self._tracer.instant("drain_replica", self._lane_router,
                                  a0=index)
         logger.info("fleet: draining replica %d", index)
+
+    # -- transport peer liveness (ISSUE 16) -----------------------------
+    def _transport_tick(self, events):
+        """One beat of the cross-process peer bus: broadcast the router
+        step, classify each peer's step-clock lag, and turn an AGREED
+        dead peer into the replica breaker/migration path.  Suspicion
+        without agreement (the ack vote timed out on a wedged survivor)
+        is a strike, never a one-sided verdict — the breaker's bounded
+        streak still converges if the peer stays silent."""
+        w = self._step_idx
+        beats = self._transport.heartbeat_tick(w)
+        timeout = self.config.transport_timeout_steps
+        for rep in self.replicas:
+            peer = rep.index + 1
+            if not rep.alive:
+                continue
+            lag = w - beats.get(peer, 0)
+            if lag <= timeout:
+                continue
+            if self._transport.vote_dead([peer], w):
+                logger.warning(
+                    "fleet: transport peer %d (replica %d) silent %d "
+                    "steps — coordinated dead verdict at router step "
+                    "%d; breaker tripped, migrating its journal",
+                    peer, rep.index, lag, w)
+                rep.failures["peer_dead"] = \
+                    rep.failures.get("peer_dead", 0) + 1
+                events["failures"].append(
+                    {"replica": rep.index, "kind": "peer_dead"})
+                if self._tracer is not None:
+                    self._tracer.instant("replica_peer_dead",
+                                         self._lane_router, a0=rep.index)
+                self._transport.mark_dead(peer)
+                self._mark_dead(rep, events)
+            else:
+                self._on_failure(
+                    rep, "peer_stale",
+                    f"transport peer {peer} silent {lag} steps, no "
+                    f"verdict agreement yet", events)
+
+    # -- telemetry-driven autoscaling (ISSUE 16) ------------------------
+    def _autoscale_tick(self, events):
+        """Resize the replica set from the unified metrics stream:
+        queue depth per active replica (waiting + running) and — when
+        the trigger is configured — the worst predicted TTFT across
+        replicas.  Pure host bookkeeping; the only expensive act is the
+        grow itself (one engine build sharing the lru-cached compiled
+        programs) or a graceful drain."""
+        cfg = self._autoscale
+        w = self._step_idx
+        if w < self._scale_cooldown_until \
+                or (cfg.evaluate_every > 1 and w % cfg.evaluate_every):
+            return
+        active = [r for r in self.replicas
+                  if r.alive and not r.draining]
+        if not active:
+            return
+        depth = sum(r.engine.scheduler.queue_depth()
+                    + len(r.engine.scheduler.running) for r in active)
+        per_replica = depth / len(active)
+        ttft = 0.0
+        if cfg.scale_up_ttft_s > 0:
+            ttft = max(r.engine.reliability.predicted_ttft_s(
+                extra_tokens=0) or 0.0 for r in active)
+        if len(active) < cfg.max_replicas \
+                and (per_replica >= cfg.scale_up_queue_depth
+                     or (cfg.scale_up_ttft_s > 0
+                         and ttft >= cfg.scale_up_ttft_s)):
+            self._scale_up(events, per_replica, ttft)
+        elif len(active) > cfg.min_replicas \
+                and per_replica <= cfg.scale_down_queue_depth \
+                and not any(r.draining for r in self.replicas):
+            self._scale_down(active, events, per_replica)
+
+    def _record_scale(self, direction, replica, events, per_replica,
+                      ttft):
+        ev = {"step": self._step_idx, "dir": direction,
+              "replica": replica,
+              "active": sum(1 for r in self.replicas
+                            if r.alive and not r.draining),
+              "queue_depth_per_replica": round(per_replica, 4),
+              "predicted_ttft_s": round(ttft, 4)}
+        self.scale_events.append(ev)
+        events["scaled"].append(dict(ev))
+        self._scale_cooldown_until = self._step_idx \
+            + self._autoscale.cooldown_steps
+        if self._tracer is not None:
+            self._tracer.instant(f"scale_{direction}", self._lane_router,
+                                 a0=replica)
+        logger.info(
+            "fleet autoscaler: scale-%s replica %d at router step %d "
+            "(queue depth/replica %.2f, predicted TTFT %.3fs) — %d "
+            "active", direction.upper(), replica, self._step_idx,
+            per_replica, ttft, ev["active"])
+
+    def _scale_up(self, events, per_replica, ttft):
+        idx = len(self.replicas)
+        rep = self._new_replica(idx, ROLE_BOTH)
+        self.replicas.append(rep)
+        # same-config engines share the lru-cached compiled programs:
+        # the grow pays host setup, never a recompile (the fleet-wide
+        # CompilationCounter pin holds through scale events)
+        rep.engine.warmup()
+        self._record_scale("up", idx, events, per_replica, ttft)
+
+    def _scale_down(self, active, events, per_replica):
+        # retire the least-loaded active replica — but never the last
+        # prefill-capable one (autoscale only arms on non-role-split
+        # fleets, so any ROLE_BOTH survivor keeps the fleet whole)
+        victim = min(active, key=lambda r: (
+            r.engine.scheduler.queue_depth()
+            + len(r.engine.scheduler.running), r.index))
+        if sum(1 for r in active if r is not victim) < 1:
+            return
+        self.drain_replica(victim.index)
+        self._record_scale("down", victim.index, events, per_replica,
+                           0.0)
 
     def _on_failure(self, rep, kind, detail, events):
         rep.failures[kind] = rep.failures.get(kind, 0) + 1
@@ -747,6 +991,8 @@ class FleetRouter:
                 "max_consecutive_failures":
                     self.config.max_consecutive_failures,
                 "retry_backoff_steps": self.config.retry_backoff_steps,
+                "autoscale_armed": self.autoscale_armed,
+                "transport_armed": self.transport_armed,
             },
             "router": {
                 "steps": self._step_idx,
@@ -760,6 +1006,11 @@ class FleetRouter:
                 "goodput_tokens_per_slot_step":
                     (agg_useful / agg_slot_steps) if agg_slot_steps
                     else None,
+                "replica_steps": self.replica_steps,
+                "goodput_tokens_per_replica_step":
+                    (agg_useful / self.replica_steps)
+                    if self.replica_steps else None,
+                "scale_events": [dict(e) for e in self.scale_events],
             },
             "replicas": {
                 f"replica{r.index}": {

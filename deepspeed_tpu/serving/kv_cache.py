@@ -187,6 +187,17 @@ class PagedKVPool:
         row[:len(blocks)] = blocks
         return row
 
+    def global_table_row(self, rid: int, width: int) -> np.ndarray:
+        """GLOBAL block ids of ``rid``: local ids offset by the owning
+        shard's base (``shard * blocks_per_shard``), padding mapped to
+        that shard's OWN trash block.  The decode shard_map sees only
+        local ids (:meth:`table_row`); a host-side gather/scatter over
+        the full pool tensors — the KV-handoff export/import path —
+        addresses the unsplit block axis and needs these."""
+        shard = self._shard_of.get(rid, 0)
+        base = np.int32(shard * self.blocks_per_shard)
+        return self.table_row(rid, width) + base
+
     def free_blocks(self, shard: int) -> int:
         """Free blocks on one shard — the admission slot-ranking signal
         (the engine steers new sequences toward the least-loaded shard)."""

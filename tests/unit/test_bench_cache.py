@@ -92,3 +92,59 @@ def test_worker_serve_flag_wired():
         b.run_worker_serve = real_serve
     finally:
         sys.argv = argv
+
+
+def test_wait_ready_bounds_every_pre_ready_phase():
+    """r04/r05 regression: the import clamp only covered the
+    importing_jax phase, so a worker wedged at the backend probe waited
+    forever (until the container kill, which leaves no evidence).  The
+    pre-ready window is now bounded in EVERY phase: import budget while
+    importing, plus a probe grace after."""
+    import time as _t
+
+    b = _bench()
+    w = b._ServeWorker.__new__(b._ServeWorker)
+    w.t0 = _t.time() - 10.0
+    w.killed = False
+    w.alive = lambda: True
+    w.kill = lambda: setattr(w, "killed", True)
+    # wedged mid-import past the budget -> killed
+    w.phases = [("importing_jax", 0.1)]
+    assert w.wait_ready(5, probe_grace_s=300.0) is False
+    assert w.killed
+    # wedged at the backend probe past budget+grace -> killed (this hung
+    # forever before)
+    w.killed = False
+    w.phases = [("importing_jax", 0.1), ("backend_up:tpu:v5e:4", 2.0)]
+    assert w.wait_ready(5, probe_grace_s=1.0) is False
+    assert w.killed
+    # ready wins immediately, whatever the clock says
+    w.killed = False
+    w.phases.append(("serve_ready", 3.0))
+    assert w.wait_ready(0, probe_grace_s=0.0) is True
+    assert not w.killed
+
+
+def test_wall_budget_exhaustion_emits_structured_json(tmp_path,
+                                                      capsys):
+    """A round with no wall left must still print the ONE structured
+    failure line and persist phase-cache evidence — the r04/r05 rounds
+    died rc=124 with neither."""
+    b = _bench()
+    cache = str(tmp_path / "cache.json")
+    args = argparse.Namespace(
+        model="gpt2-125m", batch=4, seq=256, steps=5, warmup=1,
+        scan_layers=1, remat=0, remat_policy="nothing", allow_cpu=0,
+        loss_chunk=0, offload=0, onebit=0, sparse=0, zero_stage=2,
+        chaos="", budget_s=1500, import_budget_s=300, init_retries=4,
+        retry_wait_s=60, single_attempt=False, phase_cache=cache,
+        telemetry_dir="", wall_budget_s=0)
+    rc = b.run_parent(args)
+    assert rc == 1
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    payload = json.loads(line)
+    assert payload["wall_killed"] is True
+    assert payload["attempts"][0]["wall_killed"] is True
+    assert payload["attempts"][0]["last_phase"] == "spawn"
+    saved = b._load_cache(cache)
+    assert saved["__env__"]["wall_killed"] is True

@@ -1007,6 +1007,72 @@ def test_disarmed_discipline_covers_arm_integrity_path():
     assert lint(DISARM_INTEGRITY_GOOD, rules=["disarmed-discipline"]) == []
 
 
+DISARM_AUTOSCALE_BAD = """
+class FleetRouter:
+    def _arm_autoscale(self, spec):
+        self.autoscale_armed = False
+        self._autoscale = None
+        if spec is None:
+            return
+        if self._role_split or spec.min_replicas < 1:
+            return
+        self._autoscale = spec
+        self.autoscale_armed = True
+"""
+
+DISARM_AUTOSCALE_GOOD = DISARM_AUTOSCALE_BAD.replace(
+    "            return\n        self._autoscale = spec",
+    '            logger.warning(\n'
+    '                "fleet autoscaler: DISARMED - role-split fleet / "\n'
+    '                "invalid replica bounds; the replica set stays "\n'
+    '                "fixed")\n'
+    "            return\n        self._autoscale = spec")
+
+
+def test_disarmed_discipline_covers_arm_autoscale_path():
+    """ISSUE 16 satellite: the router's autoscale arming fn is held to
+    the armed-or-warns discipline — a fleet that silently never scales
+    (the user asked for elasticity, provisioning stays frozen) fires;
+    warning DISARMED naming the blockers quiets it."""
+    got = lint(DISARM_AUTOSCALE_BAD, rules=["disarmed-discipline"])
+    assert rule_names(got) == ["disarmed-discipline"]
+    assert "_arm_autoscale" in got[0].message
+    assert lint(DISARM_AUTOSCALE_GOOD, rules=["disarmed-discipline"]) == []
+
+
+DISARM_TRANSPORT_BAD = """
+class FleetRouter:
+    def _arm_transport(self, transport):
+        self._transport = None
+        self.transport_armed = False
+        if transport is None:
+            return
+        if transport.world != len(self.replicas) + 1:
+            return
+        self._transport = transport.start()
+        self.transport_armed = True
+"""
+
+DISARM_TRANSPORT_GOOD = DISARM_TRANSPORT_BAD.replace(
+    "            return\n        self._transport = transport.start()",
+    '            logger.warning(\n'
+    '                "fleet transport: DISARMED - world does not map "\n'
+    '                "onto the replica set; replica liveness stays "\n'
+    '                "in-process")\n'
+    "            return\n        self._transport = transport.start()")
+
+
+def test_disarmed_discipline_covers_arm_transport_path():
+    """ISSUE 16 satellite: the transport-seam arming fn is held to the
+    armed-or-warns discipline — silently falling back to in-process
+    liveness (peer death then goes undetected at the process level)
+    fires; warning DISARMED naming the blockers quiets it."""
+    got = lint(DISARM_TRANSPORT_BAD, rules=["disarmed-discipline"])
+    assert rule_names(got) == ["disarmed-discipline"]
+    assert "_arm_transport" in got[0].message
+    assert lint(DISARM_TRANSPORT_GOOD, rules=["disarmed-discipline"]) == []
+
+
 # ---------------------------------------------------------------------------
 # rule: raw-ckpt-write
 # ---------------------------------------------------------------------------

@@ -690,7 +690,11 @@ def test_vote_disarmed_at_dp1_sentinels_stay(caplog):
     assert mon is not None and mon.sentinels_armed and not mon.vote_armed
 
 
-def test_integrity_disarmed_on_offload_names_blocker(caplog):
+def test_offload_arms_sentinels_vote_disarmed(caplog):
+    """ZeRO-Offload steps on HOST master shards, so the device vote is
+    DISARM-warned — but the sentinels ride the host grad-norm/overflow
+    the streaming path already computes (ISSUE 16 closes the PR-13
+    coverage gap that full-disarmed this configuration)."""
     logger = logging.getLogger("deepspeed_tpu")
     logger.propagate = True
     cfg = {
@@ -699,7 +703,7 @@ def test_integrity_disarmed_on_offload_names_blocker(caplog):
         "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
         "zero_optimization": {"stage": 2, "cpu_offload": True},
         "mesh": {"data": 2, "allow_partial": True},
-        "resilience": {"integrity": {"enabled": True}},
+        "resilience": {"integrity": {"enabled": True, "min_history": 2}},
     }
     try:
         with caplog.at_level(logging.WARNING, logger="deepspeed_tpu"):
@@ -707,16 +711,26 @@ def test_integrity_disarmed_on_offload_names_blocker(caplog):
                 model=SimpleModel(HIDDEN), config_params=cfg)
     finally:
         logger.propagate = False
-    assert engine._integrity is None
-    assert any("integrity defense DISARMED" in r.message
+    mon = engine._integrity
+    assert mon is not None and mon.sentinels_armed
+    assert not mon.vote_armed and not mon.dup_armed
+    assert any("vote DISARMED" in r.message
                and "cpu_offload" in r.message for r in caplog.records)
+    # the offload step path FEEDS the monitor: host loss + grad norm
+    rows = engine.train_micro_batch_size_per_gpu() * engine.dp_world_size
+    it = random_dataloader(HIDDEN, 256, rows, seed=7)
+    for _ in range(3):
+        engine.train_batch(data_iter=it)
+    assert mon.last_observed_step == engine.global_steps
+    assert mon.stats["loss"].count >= 1
+    assert mon.stats["grad_norm"].count >= 1
 
 
-def test_integrity_disarmed_on_pipeline_engine(caplog):
-    """The pipe interpreter cannot drive the sentinels and per-stage
-    params have no cross-stage replica to vote over — a PipelineEngine
-    (or any subclass: the block is a class flag, not a name check)
-    DISARM-warns instead of arming a monitor nothing would feed."""
+def test_pipeline_engine_arms_sentinels_vote_disarmed(caplog):
+    """Per-stage params have no cross-stage replica to vote over, so a
+    PipelineEngine (or any subclass: the block is a class flag, not a
+    name check) DISARM-warns the vote — but the sentinels ride the host
+    loss/grad-norm the pipe interpreter already fetches per step."""
     from tests.unit.simple_model import make_stack_specs
 
     specs, loss_fn, input_fn = make_stack_specs(8, 4)
@@ -734,13 +748,58 @@ def test_integrity_disarmed_on_pipeline_engine(caplog):
                     "steps_per_print": 10 ** 9,
                     "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
                     "mesh": {"pipe": 2, "data": 1, "allow_partial": True},
-                    "resilience": {"integrity": {"enabled": True}}})
+                    "resilience": {"integrity": {"enabled": True,
+                                                 "min_history": 2}}})
     finally:
         logger.propagate = False
-    assert engine._integrity is None
+    mon = engine._integrity
+    assert mon is not None and mon.sentinels_armed
+    assert not mon.vote_armed and not mon.dup_armed
     assert not engine._integrity_armable
-    assert any("integrity defense DISARMED" in r.message
+    assert any("vote DISARMED" in r.message
                and "PipelineEngine" in r.message for r in caplog.records)
+    it = random_dataloader(8, 32, 8, seed=0)
+    for _ in range(2):
+        assert np.isfinite(engine.train_batch(data_iter=it))
+    assert mon.last_observed_step == engine.global_steps
+    assert mon.stats["loss"].count >= 1
+    assert mon.stats["grad_norm"].count >= 1
+
+
+def test_stage3_gathered_vote_assembles_and_agrees():
+    """Stage 3 arms the GATHERED vote: sharded param leaves are
+    all_gather-assembled inside the cadence jit and every rank folds its
+    own assembled copy.  Healthy state is unanimous; a shard corrupted
+    AT REST assembles identically on every rank — unanimous by design
+    (the sentinels own that case; the gathered digest exists for
+    asymmetric gather/assembly divergence)."""
+    cfg = {
+        "steps_per_print": 10 ** 9,
+        "train_batch_size": GLOBAL_BATCH,
+        "train_micro_batch_size_per_gpu": GLOBAL_BATCH // 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "zero_optimization": {"stage": 3},
+        "mesh": {"data": 2, "allow_partial": True},
+        "resilience": {"integrity": {"enabled": True, "min_history": 2,
+                                     "vote_every_steps": 1}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(HIDDEN), config_params=cfg)
+    mon = engine._integrity
+    assert mon is not None and mon.vote_armed and mon.vote_gathered
+    assert not mon.dup_armed    # replayed micro would see shard shapes
+    it = _data_factory(engine)
+    engine.train_batch(data_iter=it)
+    got = integrity.state_vote(engine)
+    assert got["unanimous"]
+    names = mon._vote_leaf_names
+    assert any("[gathered]" in n for n in names)
+    assert got["digests"].shape == (2, len(names))
+    assert mon.report()["vote_mode"] == "gathered"
+    # at-rest shard corruption: every rank assembles the same corrupted
+    # array — the documented blind spot the sentinels cover
+    integrity._flip_state_leaf(engine, "params", 1, W1_LEAF, 0, 30)
+    assert integrity.state_vote(engine)["unanimous"]
 
 
 def test_chaos_flip_consumed_once():

@@ -246,6 +246,43 @@ def test_sharded_decode_parity_and_zero_collectives(toy, eight_devices):
     hc.assert_no_host_transfers(hlo, "sharded serving decode")
 
 
+def test_sharded_pool_kv_handoff_bit_identical(toy, eight_devices):
+    """Per-shard KV handoff (ISSUE 16 lifts the PR-11 shards=1 limit):
+    a SHARDED source pool exports GLOBAL block rows and a sharded
+    destination adopts them into whichever shard its free slot pins —
+    decode resumes bit-identically with no re-prefill, including for a
+    request whose blocks live on a non-zero source shard (the case the
+    old local-id gather would have silently mis-addressed)."""
+    from jax.sharding import Mesh
+
+    model, params, ref = toy
+    mesh = Mesh(np.array(eight_devices[:2]), ("data",))
+    eng_a = _engine(model, params, max_slots=4, shards=2, mesh=mesh)
+    eng_b = _engine(model, params, max_slots=4, shards=2, mesh=mesh)
+    prompts = _prompts(21, (5, 9, 7, 6))
+    maxnew = [8, 6, 7, 9]
+    rids = [eng_a.submit(p, max_new_tokens=m, _rid=100 + i)
+            for i, (p, m) in enumerate(zip(prompts, maxnew))]
+    for _ in range(3):
+        eng_a.step()
+    by_shard = {eng_a.scheduler.requests[r].shard for r in rids
+                if eng_a.scheduler.requests[r].state.value == "running"}
+    assert by_shard == {0, 1}, "fixture must populate both source shards"
+    moved = {}
+    for rid, p, m in zip(list(rids), prompts, maxnew):
+        req = eng_a.scheduler.requests.get(rid)
+        if req is None or req.state.value != "running":
+            continue
+        entry = eng_a.export_request(rid)
+        assert eng_b.import_request(entry) == "adopted"
+        moved[rid] = (p, m)
+    assert len(moved) >= 2
+    res_b = eng_b.serve(max_steps=500)
+    for rid, (p, m) in moved.items():
+        assert res_b[rid]["status"] == "finished"
+        np.testing.assert_array_equal(res_b[rid]["tokens"], ref(p, m))
+
+
 def test_decode_collectives_accounting():
     from deepspeed_tpu.runtime import comm_accounting as ca
 
@@ -413,6 +450,25 @@ def test_pool_allocator_occupancy_and_fragmentation():
     pool.free(1)
     pool.free(2)
     assert pool.blocks_in_use == 0 and pool.fragmentation() == 0.0
+
+
+def test_global_table_row_offsets_by_owning_shard():
+    """The KV-handoff export/import path addresses the UNSPLIT block
+    axis: global ids = local + shard * blocks_per_shard, with padding
+    mapped to the owning shard's OWN trash block (never shard 0's)."""
+    cfg = GPT2Config(vocab_size=32, n_positions=64, n_embd=8, n_layer=1,
+                     n_head=2, dtype=jnp.float32, loss_chunk_tokens=0)
+    pool = PagedKVPool(cfg, num_blocks=8, block_size=4, shards=2)
+    assert pool.blocks_per_shard == 4
+    assert pool.alloc(7, 1, 8)            # 2 blocks pinned to shard 1
+    local = pool.table_row(7, 4)
+    glob = pool.global_table_row(7, 4)
+    assert (local[:2] >= 1).all() and (local[:2] < 4).all()
+    np.testing.assert_array_equal(glob, local + 4)
+    assert (glob[2:] == 4).all()          # shard 1's trash block
+    assert pool.alloc(3, 0, 4)            # shard 0: global == local
+    np.testing.assert_array_equal(pool.global_table_row(3, 4),
+                                  pool.table_row(3, 4))
 
 
 def test_submit_rejects_oversized_requests(toy):

@@ -47,6 +47,7 @@ HOT_FILES = {
     "deepspeed_tpu/serving/fleet.py",
     "deepspeed_tpu/runtime/resilience/supervisor.py",
     "deepspeed_tpu/runtime/resilience/integrity.py",
+    "deepspeed_tpu/runtime/resilience/transport.py",
 }
 HOT_FN_RE = re.compile(
     r"^(train_batch|eval_batch|forward|backward|step"
@@ -82,7 +83,15 @@ HOT_FN_RE = re.compile(
     # fetch per cadence hit — a per-leaf or per-rank device_get loop
     # would serialize the whole state against the host
     r"|observe_step|decide|note_micro|state_vote|dup_check"
-    r"|apply_chaos_faults|_integrity_tick|_skip_and_reseat)$")
+    r"|apply_chaos_faults|_integrity_tick|_skip_and_reseat"
+    # transport seam + autoscaling (ISSUE 16): the heartbeat bus, ack
+    # vote and result drain run once per wall/router step (transport.py
+    # is all-host by contract — no jax import, ever), and the router's
+    # transport/autoscale ticks are pure telemetry bookkeeping — a
+    # device sync there stalls every replica's step clock
+    r"|heartbeat_tick|vote_dead|poll_results|request|handoff"
+    r"|_transport_tick|_autoscale_tick|_scale_up|_scale_down"
+    r"|_record_scale)$")
 # benchmark drivers: every loop is (or brackets) a timed region — a sync
 # per iteration pollutes the measured step time with transfer latency
 BENCH_FILES = {"bench.py", "tools/pipe_bench.py", "tools/serve_bench.py"}

@@ -28,6 +28,16 @@ Traffic modes (``--traffic``):
   the guard is that EVERY request still completes (zero lost) and the
   reported p95-TTFT / goodput ratios are the measured price of losing
   1/K of the fleet.
+- ``diurnal`` — the autoscaling A/B (ISSUE 16): a quiet->peak->quiet
+  arrival profile served twice on the step clock — once by a STATIC
+  fleet provisioned for the peak (``--fleet K`` replicas the whole
+  run) and once by an autoscaled fleet that starts at 1 replica, grows
+  on queue depth through the peak and drains back down through the
+  tail.  The honest efficiency number is goodput per REPLICA-step
+  (useful tokens / sum of alive replicas over steps — the bill you pay
+  for provisioned capacity, busy or idle); the guard is that the
+  autoscaler scales up AND back down, loses zero requests, and beats
+  the static-peak fleet on goodput per replica-step.
 
 Two throughput views everywhere:
 
@@ -423,11 +433,132 @@ def run_replica_failure(model, params, args, out):
     return 0 if ok else 1
 
 
+def _diurnal_arrivals(n, *, quiet_every=4, peak_per_step=3,
+                      quiet_frac=0.15):
+    """Arrival steps for one quiet -> peak -> quiet day: ``quiet_frac``
+    of the requests trickle in at 1 every ``quiet_every`` steps on each
+    shoulder, the rest burst at ``peak_per_step`` per step in between.
+    The long sparse shoulders are the point of the A/B: a fleet
+    provisioned for the peak idles through them (and pays replica-steps
+    for it), an autoscaled one does not."""
+    n_quiet = max(1, int(n * quiet_frac))
+    n_peak = n - 2 * n_quiet
+    arrivals, step = [], 0
+    for _ in range(n_quiet):                    # morning trough
+        arrivals.append(step)
+        step += quiet_every
+    for i in range(n_peak):                     # midday burst
+        arrivals.append(step + i // peak_per_step)
+    step = arrivals[-1] + 1
+    for _ in range(n_quiet):                    # evening trough
+        arrivals.append(step)
+        step += quiet_every
+    return arrivals
+
+
+def run_diurnal(model, params, args, out):
+    """Autoscaling A/B (ISSUE 16): static peak-provisioned fleet vs an
+    autoscaled fleet over the same diurnal arrival profile, compared on
+    goodput per replica-step."""
+    import tempfile
+    import time as time_mod
+
+    from deepspeed_tpu.serving.fleet import AutoscaleConfig, FleetRouter
+
+    workload = make_workload(args.requests, args.vocab, args.seed)
+    arrivals = _diurnal_arrivals(len(workload))
+
+    def drive(autoscaled):
+        clock = StepClock()
+        jd = tempfile.mkdtemp(prefix="serve_bench_diurnal_")
+        kw = dict(clock=clock, journal_dir=jd,
+                  engine_kwargs=dict(max_slots=args.slots,
+                                     kv_block_size=16,
+                                     prefill_chunk=args.chunk,
+                                     max_blocks_per_seq=8))
+        if autoscaled:
+            router = FleetRouter(
+                model, params, replicas=1,
+                autoscale=AutoscaleConfig(
+                    min_replicas=1, max_replicas=args.fleet,
+                    scale_up_queue_depth=2.0 * args.slots,
+                    scale_down_queue_depth=0.5 * args.slots,
+                    cooldown_steps=4), **kw)
+        else:
+            router = FleetRouter(model, params, replicas=args.fleet,
+                                 **kw)
+        router.warmup()
+        t0 = time_mod.perf_counter()
+        pending = [(arrivals[i], w) for i, w in enumerate(workload)]
+        rids, steps = [], 0
+        while pending or router.has_work():
+            while pending and pending[0][0] <= steps:
+                _, (prompt, max_new) = pending.pop(0)
+                rids.append(router.submit(prompt,
+                                          max_new_tokens=max_new))
+            router.step()
+            clock.t += 1.0
+            steps += 1
+            assert steps < 10000, "diurnal bench did not converge"
+        wall = time_mod.perf_counter() - t0
+        rep = router.fleet_report()
+        res = router.results
+        finished = sum(1 for rid in rids
+                       if res.get(rid, {}).get("status") == "finished")
+        return {
+            "autoscaled": autoscaled,
+            "submitted": len(rids), "completed": finished,
+            "steps": steps, "wall_s": _r(wall),
+            "replicas_end": rep["config"]["replicas"],
+            "replica_steps": rep["router"]["replica_steps"],
+            "scale_events": rep["router"]["scale_events"],
+            "lost": rep["router"]["lost"],
+            "ttft_mean": _r(rep["router"]["ttft_s"]["mean"]),
+            "ttft_p95": _r(rep["router"]["ttft_s"]["p95"]),
+            "goodput_tokens_per_slot_step":
+                _r(rep["router"]["goodput_tokens_per_slot_step"]),
+            "goodput_tokens_per_replica_step":
+                _r(rep["router"]["goodput_tokens_per_replica_step"]),
+        }
+
+    static = drive(False)
+    auto = drive(True)
+    out.update({"static": static, "autoscaled": auto,
+                "fleet_max": args.fleet,
+                "latency_unit": "serving steps (step clock)"})
+    out["goodput_per_replica_step_ratio"] = _r(
+        auto["goodput_tokens_per_replica_step"]
+        / static["goodput_tokens_per_replica_step"], 3) \
+        if static["goodput_tokens_per_replica_step"] else None
+    for tag, row in (("static (peak-K)", static), ("autoscaled", auto)):
+        ups = sum(1 for e in row["scale_events"] if e["dir"] == "up")
+        downs = sum(1 for e in row["scale_events"] if e["dir"] == "down")
+        print(f"{tag:>18}: {row['completed']}/{row['submitted']} done "
+              f"in {row['steps']} steps | {row['replica_steps']} "
+              f"replica-steps | goodput/replica-step "
+              f"{row['goodput_tokens_per_replica_step']} | TTFT p95 "
+              f"{row['ttft_p95']} | scale up {ups} / down {downs}")
+    ups = sum(1 for e in auto["scale_events"] if e["dir"] == "up")
+    downs = sum(1 for e in auto["scale_events"] if e["dir"] == "down")
+    ok = (auto["completed"] == auto["submitted"] and not auto["lost"]
+          and ups >= 1 and downs >= 1
+          and auto["goodput_tokens_per_replica_step"]
+          >= static["goodput_tokens_per_replica_step"])
+    out["guard_ok"] = ok
+    print(f"diurnal autoscale guard: {'OK' if ok else 'FAIL'} — "
+          f"{ups} scale-up / {downs} scale-down, "
+          f"{out['goodput_per_replica_step_ratio']}x goodput per "
+          f"replica-step vs the static {args.fleet}-replica fleet, "
+          f"zero lost")
+    return 0 if ok else 1
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--traffic", default="steady",
                    choices=["steady", "bursty", "overload",
-                            "shared-prefix", "replica-failure"])
+                            "shared-prefix", "replica-failure",
+                            "diurnal"])
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--requests", type=int, default=32)
     p.add_argument("--chunk", type=int, default=16)
@@ -449,7 +580,8 @@ def main(argv=None):
     p.add_argument("--deadline-steps", type=float, default=24.0,
                    help="per-request deadline in steps (overload)")
     p.add_argument("--fleet", type=int, default=3,
-                   help="replicas behind the router (replica-failure)")
+                   help="replicas behind the router (replica-failure); "
+                        "peak/max replicas (diurnal)")
     p.add_argument("--kill-step", type=int, default=12,
                    help="engine step at which chaos hard-kills replica "
                         "1 (replica-failure)")
@@ -463,7 +595,8 @@ def main(argv=None):
     rc = {"steady": run_steady, "bursty": run_bursty,
           "overload": run_overload,
           "shared-prefix": run_shared_prefix,
-          "replica-failure": run_replica_failure}[args.traffic](
+          "replica-failure": run_replica_failure,
+          "diurnal": run_diurnal}[args.traffic](
         model, params, args, out)
     if args.json:
         with open(args.json, "w") as f:

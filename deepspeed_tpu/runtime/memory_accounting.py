@@ -243,15 +243,27 @@ def quantization_scratch_bytes(leaves: Sequence[LeafSpec], dp: int,
 def kv_pool_bytes(n_layer: int, num_blocks: int, n_head: int,
                   block_size: int, head_dim: int, *,
                   kv_dtype="bfloat16", quantized: bool = False,
-                  shards: int = 1) -> int:
+                  shards: int = 1, shared_blocks: int = 0,
+                  shared_refs: int = 1) -> int:
     """Per-shard device bytes of the serving paged KV pool: k + v of
     ``(L, num_blocks/shards, H, block_size, D)`` (int8 when quantized,
     else ``kv_dtype``) plus the two fp32 per-(token, head)-row scale
     tensors int8 storage carries.  THE builder both
     ``PagedKVPool.stats()`` and the serving ``memory_report()`` price
-    the pool through — byte-exact against the allocated arrays."""
-    assert num_blocks % shards == 0, (num_blocks, shards)
-    bps = num_blocks // shards
+    the pool through — byte-exact against the allocated arrays.
+
+    Under prefix sharing (ISSUE 17), ``num_blocks`` may be the LOGICAL
+    block demand of the workload: ``shared_blocks`` distinct blocks each
+    mapped read-only by ``shared_refs`` requests are stored ONCE, so the
+    physical pool shrinks by ``shared_blocks * (shared_refs - 1)`` —
+    refcounted shared storage is never priced per reference.  The
+    defaults (no sharing) price exactly the allocated arrays."""
+    assert shared_blocks >= 0 and shared_refs >= 1, \
+        (shared_blocks, shared_refs)
+    physical = num_blocks - shared_blocks * (shared_refs - 1)
+    assert physical > 0, (num_blocks, shared_blocks, shared_refs)
+    assert physical % shards == 0, (physical, shards)
+    bps = physical // shards
     store = 1 if quantized else np.dtype(kv_dtype).itemsize
     kv = 2 * n_layer * bps * n_head * block_size * head_dim * store
     scales = 2 * n_layer * bps * n_head * block_size * 4 if quantized else 0

@@ -226,6 +226,60 @@ def _make_decode_step(cfg, W, bs, quantized, temperature, top_k, top_p,
                        in_streams=(True,) * 6, n_out_streams=2)
 
 
+@functools.lru_cache(maxsize=64)
+def _make_spec_verify(cfg, K, W, bs, quantized, mesh, axis_name):
+    """Self-speculative draft-verify: K+1 query tokens per lane — the
+    current token plus K drafted — scored in ONE fixed-shape batched
+    step.  The host accepts the longest prefix of drafts matching the
+    program's own argmax continuations, plus one bonus token; that is
+    bit-identical to step-by-step greedy BY CONSTRUCTION, because output
+    i is only ever consumed when drafts 1..i already equal the true
+    greedy tokens — at which point the KV rows written for them are
+    exactly what sequential decode would have written, and rejected
+    positions are overwritten by the next dispatch before any query can
+    attend them unmasked.  Greedy-only (the arming gate enforces
+    temperature == 0), so no sampling seeds enter the program.
+
+    ``nvalid`` (per lane) bounds the query positions that may write and
+    that feed the finiteness detector: a lane within K tokens of its
+    token budget masks the surplus positions to the trash block, so
+    near-capacity lanes neither write past their page table nor trip
+    false poison quarantines on clamped-gather garbage."""
+    def run(params, *args):
+        pools = args[:4] if quantized else args[:2] + (None, None)
+        tables, pos, toks, nvalid, active, poison = args[-6:]
+        S, T = toks.shape
+        posns = pos[:, None] + jnp.arange(T)[None, :]          # (S, T)
+        x = params["wte"].astype(cfg.dtype)[toks] \
+            + params["wpe"].astype(cfg.dtype)[
+                jnp.minimum(posns, cfg.n_positions - 1)]       # (S, T, E)
+        x = x + poison.astype(cfg.dtype)[:, None, None]
+        valid_q = jnp.arange(T)[None, :] < nvalid[:, None]     # (S, T)
+        writable = active[:, None] & valid_q & (posns < W * bs)
+        blk = jnp.where(
+            writable,
+            tables[jnp.arange(S)[:, None],
+                   jnp.minimum(posns // bs, W - 1)],
+            TRASH_BLOCK)
+        off = posns % bs
+        maxpos = pos + nvalid - 1                              # (S,)
+        x, pools = _paged_forward(params, cfg, pools, tables, posns,
+                                  maxpos, blk.reshape(-1),
+                                  off.reshape(-1), x, quantized)
+        logits = _lm_logits(params, cfg,
+                            x.reshape(S * T, -1)).reshape(S, T, -1)
+        finite = jnp.where(valid_q, jnp.isfinite(logits).all(-1),
+                           True).all(axis=1)                   # (S,)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)    # (S, T)
+        nxt = jnp.where(active[:, None], nxt, 0)
+        out = pools[:4] if quantized else pools[:2]
+        return (*out, nxt, finite)
+
+    n_pool = 4 if quantized else 2
+    return _shard_wrap(run, mesh, axis_name, n_pool,
+                       in_streams=(True,) * 6, n_out_streams=2)
+
+
 @functools.lru_cache(maxsize=256)
 def _make_prefill_chunk(cfg, C, W, bs, quantized, final, temperature,
                         top_k, top_p, mesh, axis_name):
@@ -278,7 +332,8 @@ class InferenceEngine:
                  quantize_kv=False, temperature=0.0, top_k=0, top_p=0.0,
                  policy="continuous", shards=1, mesh=None,
                  axis_name="data", watchdog=None, clock=time.monotonic,
-                 reliability=None, telemetry=None):
+                 reliability=None, telemetry=None, prefix_cache=False,
+                 speculative=None):
         cfg = model.config
         assert not getattr(cfg, "moe_num_experts", 0), \
             "InferenceEngine serves dense blocks only: chunked prefill " \
@@ -312,11 +367,14 @@ class InferenceEngine:
         self.top_k = int(top_k or 0)
         self.top_p = float(top_p or 0.0)
         self.scheduler = Scheduler(max_slots, policy=policy)
-        # admission placement: prefer the slot whose shard has the most
-        # free KV blocks, so new sequences spread across shard pools
-        # instead of piling evictions onto shard 0
-        self.scheduler.slot_ranker = \
-            lambda s: self.pool.free_blocks(self._shard_for_slot(s))
+        # admission placement: prefer the slot whose shard already holds
+        # the candidate's cached prefix (prefix-cache locality beats raw
+        # headroom — a hit skips whole prefill chunks), then the slot
+        # whose shard has the most free KV blocks, so new sequences
+        # spread across shard pools instead of piling evictions onto
+        # shard 0
+        self.scheduler.slot_ranker = self._rank_slot
+        self.scheduler.prefix_probe = self._prefix_probe
         self.clock = clock
         self.metrics = ServingMetrics(clock)
         self.results = {}
@@ -344,6 +402,71 @@ class InferenceEngine:
         self._decode = _make_decode_step(
             cfg, self.W, self.bs, self.pool.quantized, self.temperature,
             self.top_k, self.top_p, mesh, axis_name)
+        self.prefix_cache = self._arm_prefix_cache(prefix_cache,
+                                                   quantize_kv)
+        self._readmit_rids = set()
+        self.spec_k = self._arm_speculative(speculative)
+        self._spec = None
+        self._drafts = np.zeros((S, max(1, self.spec_k)), np.int32)
+        if self.spec_k:
+            self._spec = _make_spec_verify(
+                cfg, self.spec_k, self.W, self.bs, self.pool.quantized,
+                mesh, axis_name)
+
+    def _arm_prefix_cache(self, requested, quantize_kv_requested):
+        """COW shared-prefix caching arms only where its bookkeeping is
+        honest; every blocked request warns loudly naming the blocker
+        (the armed-or-warns DISARMED discipline).  The cache itself is
+        sampling-safe — cached KV rows are a pure function of the token
+        prefix — so unlike speculation it does NOT require greedy."""
+        if not requested:
+            return False
+        if quantize_kv_requested and not self.pool.quantized:
+            logger.warning(
+                "prefix cache: DISARMED — int8 KV was requested but the "
+                "pool disarmed it (off-profitability: scale overhead >= "
+                "byte savings at this head_dim/dtype); refusing to stack "
+                "block sharing on a pool whose storage already silently "
+                "differs from the asked-for config.  Serving without "
+                "prefix caching.")
+            return False
+        if self.scheduler.draining:
+            logger.warning(
+                "prefix cache: DISARMED — the engine is draining: "
+                "admission is closed, so no request could ever consult "
+                "the tree; arming now would only pin blocks a successor "
+                "cannot inherit.")
+            return False
+        return True
+
+    def _arm_speculative(self, spec):
+        """Self-speculative decoding (``speculative=k`` or
+        ``{"draft_len": k}``) arms only in the greedy configuration:
+        acceptance compares ARGMAX continuations token-for-token, so
+        with sampling (temperature > 0) the accepted prefix would not
+        equal what the sampled step-by-step stream emits — blocked
+        requests warn DISARMED naming the blocker and serve the plain
+        one-token decode jit instead.  Returns the armed draft length
+        (0 = disarmed)."""
+        if not spec:
+            return 0
+        k = int(spec.get("draft_len", 4)) if isinstance(spec, dict) \
+            else int(spec)
+        if k < 1:
+            logger.warning(
+                "speculative decoding: DISARMED — draft_len=%d < 1 "
+                "drafts nothing; serving the plain decode jit.", k)
+            return 0
+        if self.temperature != 0.0:
+            logger.warning(
+                "speculative decoding: DISARMED — sampling != greedy: "
+                "temperature=%g, but the acceptance rule (accepted "
+                "prefix == step-by-step greedy argmax) is only defined "
+                "at temperature=0; a sampled stream would diverge from "
+                "the verified continuations.  Serving the plain decode "
+                "jit.", self.temperature)
+            return 0
+        return k
 
     def _arm_telemetry(self, spec):
         """Arm the serving telemetry session from the ``telemetry=``
@@ -477,7 +600,9 @@ class InferenceEngine:
             if _readmit:
                 # already-admitted work (recovery/migration): bypass the
                 # shedding gate, but journal it here so THIS engine's
-                # crash covers it too
+                # crash covers it too.  Tagged so the prefix probe can
+                # attribute cache savings to the recovery path.
+                self._readmit_rids.add(rid)
                 if self.reliability.journal is not None:
                     self.reliability.journal.record_submit(req)
             elif self.reliability.on_submit(req) == "reject":
@@ -562,6 +687,16 @@ class InferenceEngine:
             "poisoned": rel.aborts[ABORT_POISONED],
             "journal_depth": rel.journal_depth(),
             "draining": self.scheduler.draining,
+            # prefix cache + speculation ride the same host-dict idiom:
+            # scalar values flow into the fleet's flattened
+            # replica_metrics automatically, the histogram dict is
+            # aggregated explicitly by FleetRouter.telemetry_report()
+            "prefix_hit_rate": self.metrics.prefix_hit_rate(),
+            "prefix_avoided_tokens": self.metrics.prefix_avoided_tokens,
+            "prefill_tokens_computed":
+                self.metrics.prefill_computed_tokens,
+            "tokens_per_verify": self.metrics.tokens_per_verify(),
+            "spec_accept_hist": dict(self.metrics.spec_accept_hist),
         }
         if tr is not None:
             tr.complete("serving_step", self._lane_serve, _t0,
@@ -846,6 +981,10 @@ class InferenceEngine:
             # max_new=1
             self.submit(np.zeros(1, np.int32), max_new_tokens=2)
         self.serve()
+        if self.prefix_cache:
+            # the COW-split copy is the one non-jit device program the
+            # cache can reach — compile it here, inside warmup
+            self.pool.warm_cow()
         self._warming = False
         self.results.clear()
         self.metrics.reset()
@@ -870,6 +1009,8 @@ class InferenceEngine:
             "policy": self.scheduler.policy,
             "temperature": self.temperature, "top_k": self.top_k,
             "top_p": self.top_p,
+            "prefix_cache": self.prefix_cache,
+            "speculative_draft_len": self.spec_k,
         }
         rep["kv_pool"]["now"] = self.pool.stats()
         rep["reliability"] = self.reliability.report()
@@ -955,6 +1096,17 @@ class InferenceEngine:
                 self._poison)
         return self._decode.lower(*args).compile().as_text()
 
+    def spec_hlo(self) -> str:
+        """Compiled HLO of the draft-verify program (same contracts as
+        the decode jit: host-transfer-free, pool donated, zero
+        collectives).  Only callable when speculation is armed."""
+        assert self.spec_k, "spec_hlo() requires speculative decoding"
+        toks = np.zeros((self.max_slots, self.spec_k + 1), np.int32)
+        nvalid = np.zeros(self.max_slots, np.int32)
+        args = (self.params, *self.pool.tensors.arrays, self._tables,
+                self._pos, toks, nvalid, self._active, self._poison)
+        return self._spec.lower(*args).compile().as_text()
+
     def n_pool_tensors(self) -> int:
         return len(self.pool.tensors.arrays)
 
@@ -979,6 +1131,37 @@ class InferenceEngine:
 
     def _shard_for_slot(self, slot):
         return slot // (self.max_slots // self.shards)
+
+    def _rank_slot(self, slot, req=None):
+        """Admission slot score: (cached-prefix coverage on the slot's
+        shard, free blocks).  Pure host walk of the radix tree — no
+        device syncs on the admission path."""
+        shard = self._shard_for_slot(slot)
+        hit = 0
+        if req is not None and self.prefix_cache and not self._warming:
+            full, _, cow_len = self.pool.prefix_lookup(
+                shard, req.full_tokens)
+            hit = len(full) * self.bs + cow_len
+        return (hit, self.pool.free_blocks(shard))
+
+    def _prefix_probe(self, req):
+        """Admission-time prefix consult (installed as the scheduler's
+        ``prefix_probe``): map cached prompt blocks read-only into the
+        new request's page table and advance ``prefill_done`` past them
+        — the covered chunks are never dispatched.  Journal-replayed and
+        migration-readmitted requests take the same path (their
+        ``full_tokens`` re-prefill shares the prompt blocks), which is
+        the fleet-honesty fix: recovery no longer re-prefills from
+        token 0 when the prompt's KV is already resident."""
+        req.shard = self._shard_for_slot(req.slot)
+        if not self.prefix_cache or self._warming:
+            return 0
+        hit = self.pool.prefix_attach(req.rid, req.shard, req.full_tokens)
+        if hit:
+            req.prefill_done = hit
+        self.metrics.record_prefix_lookup(
+            hit, readmit=req.rid in self._readmit_rids)
+        return hit
 
     def _ensure_blocks(self, req, n_positions, *, admission, events):
         """Grow ``req``'s page table to cover ``n_positions``, preempting
@@ -1124,6 +1307,7 @@ class InferenceEngine:
         out = fn(self.params, *self.pool.tensors.arrays, rows, tok_pad,
                  np.int32(start), nv, np.int32(req.seed))
         req.work_done += n
+        self.metrics.record_prefill(n)
         if final:
             # ONE batched fetch: the sampled token and the non-finite-
             # logits detector travel together (no extra host sync)
@@ -1135,12 +1319,128 @@ class InferenceEngine:
             if not ok:
                 self._abort(req, ABORT_POISONED, events)
                 return
+            if self.prefix_cache and not self._warming:
+                # publish the (finite-checked) prompt blocks into the
+                # radix tree — the next request sharing this prefix
+                # skips their prefill chunks entirely
+                self.pool.prefix_insert(req.rid, req.shard, req.prompt)
             self._on_new_token(req, first, events, promote=True)
         else:
             self._rebind(out)
             req.prefill_done = start + n
 
+    def _draft_tokens(self, req, k):
+        """Host-side n-gram drafter: propose the continuation that
+        followed the most recent earlier occurrence of the current last
+        token (repeating the last token when history has none).
+        Deterministic and correctness-free — the verify step accepts
+        only the bit-exact greedy prefix, so a bad draft costs speed,
+        never parity."""
+        toks = req.full_tokens
+        last = int(toks[-1])
+        out = None
+        for i in range(len(toks) - 2, -1, -1):
+            if int(toks[i]) == last:
+                cont = [int(t) for t in toks[i + 1:i + 1 + k]]
+                if cont:
+                    out = cont
+                break
+        if out is None:
+            out = [last]
+        while len(out) < k:
+            out.append(out[-1])
+        return out[:k]
+
+    def _spec_decode_tick(self, events):
+        """Speculative variant of the decode tick: ONE fixed-shape
+        draft-verify dispatch scores the current token plus K drafts per
+        lane; the host accepts the longest draft prefix matching the
+        program's own argmax stream (plus the bonus token).  Same
+        single-batched-fetch / poison-quarantine / zero-recompile
+        discipline as the plain tick."""
+        sch = self.scheduler
+        if not sch.running:
+            return 0
+        K = self.spec_k
+        # growth: each lane writes up to min(K+1, remaining) positions
+        # this step — cover them, preempting within the shard if needed
+        for slot in sorted(sch.running):
+            req = sch.running.get(slot)
+            if req is None:
+                continue
+            n = min(K + 1, req.max_new_tokens - len(req.generated))
+            self._ensure_blocks(req, int(self._pos[slot]) + n,
+                                admission=False, events=events)
+        running = dict(sch.running)
+        if not running:
+            return 0
+        if chaos.serving_poison_step(self._step_idx):
+            victim = max(running.values(), key=lambda r: r.submit_seq)
+            self._poison[victim.slot] = np.nan
+            chaos.record_serving_poison(victim.rid)
+        nvalid = np.zeros(self.max_slots, np.int32)
+        toks_in = np.zeros((self.max_slots, K + 1), np.int32)
+        for slot, req in running.items():
+            self._tables[slot] = self.pool.table_row(req.rid, self.W)
+            n = min(K + 1, req.max_new_tokens - len(req.generated))
+            nvalid[slot] = n
+            toks_in[slot, 0] = self._tok[slot]
+            drafts = self._draft_tokens(req, K)
+            toks_in[slot, 1:] = drafts
+            self._drafts[slot] = drafts
+            req.work_done += n
+        tel = self.telemetry
+        if tel is not None:
+            from deepspeed_tpu.runtime import memory_accounting as mem_acc
+            from deepspeed_tpu.telemetry import register_by_shape
+
+            spec_args = (self.params, *self.pool.tensors.arrays,
+                         self._tables, self._pos, toks_in, nvalid,
+                         self._active, self._poison)
+            register_by_shape(tel.mfu, "spec_verify", self._spec,
+                              spec_args)
+            mem_acc.register_by_shape(
+                self._memacct, "spec_verify", self._spec, spec_args,
+                expect_label="serving draft-verify step: donated "
+                "in-place KV block pool + argmax continuations")
+        out = self._spec(self.params, *self.pool.tensors.arrays,
+                         self._tables, self._pos, toks_in, nvalid,
+                         self._active, self._poison)
+        self._rebind(out[:-2])
+        chaos.serving_kill_step(self._step_idx)
+        chaos.fleet_kill_replica_step(self._replica_index, self._step_idx)
+        # ONE batched fetch per step: K+1 argmax tokens per lane + the
+        # per-lane finiteness detector travel together
+        outs, fins = jax.device_get((out[-2], out[-1]))
+        outs = np.asarray(outs)
+        fins = np.asarray(fins)
+        self._poison[:] = 0.0
+        for slot, req in running.items():
+            if not fins[slot]:
+                self._abort(req, ABORT_POISONED, events)
+                continue
+            row = outs[slot]
+            drafts = self._drafts[slot]
+            m = 1
+            while m <= K and drafts[m - 1] == row[m - 1]:
+                m += 1
+            m = min(m, int(nvalid[slot]))
+            consumed = 0
+            for i in range(m):
+                consumed += 1
+                self._on_new_token(req, int(row[i]), events,
+                                   promote=False)
+                if req.done:
+                    break
+            self.metrics.record_verify(consumed)
+            if sch.running.get(slot) is req:
+                self._pos[slot] += consumed
+                self._tok[slot] = int(row[consumed - 1])
+        return len(running)
+
     def _decode_tick(self, events):
+        if self.spec_k:
+            return self._spec_decode_tick(events)
         sch = self.scheduler
         if not sch.running:
             return 0

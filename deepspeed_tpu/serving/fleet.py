@@ -495,6 +495,7 @@ class FleetRouter:
             "lost": len(self.lost),
             "replica_steps": self.replica_steps,
             "scale_events": len(self.scale_events),
+            **self._cache_spec_aggregates(),
         }
         if tr is not None:
             tr.complete("router_step", self._lane_router, _t0,
@@ -963,6 +964,38 @@ class FleetRouter:
                 return t
         return None
 
+    def _cache_spec_aggregates(self) -> dict:
+        """Fleet-wide prefix-cache and speculative-decode accounting:
+        sums of every replica's counters, with the ratios recomputed
+        from the sums (a mean of per-replica rates would weight an
+        idle replica the same as a saturated one).  Migrated and
+        journal-recovered requests re-enter through the normal
+        admission probe, so the tokens their re-prefill did NOT pay
+        for show up here as ``migration_avoided_prefill_tokens``."""
+        reps = [r.engine.metrics for r in self.replicas]
+        lookups = sum(m.prefix_lookups for m in reps)
+        hits = sum(m.prefix_hits for m in reps)
+        avoided = sum(m.prefix_avoided_tokens for m in reps)
+        readmit = sum(m.readmit_avoided_tokens for m in reps)
+        verify = sum(m.spec_verify_steps for m in reps)
+        accepted = sum(m.spec_accepted_tokens for m in reps)
+        hist: dict = {}
+        for m in reps:
+            for k, v in m.spec_accept_hist.items():
+                hist[k] = hist.get(k, 0) + v
+        return {
+            "prefix_lookups": lookups,
+            "prefix_hits": hits,
+            "prefix_hit_rate": (hits / lookups) if lookups else None,
+            "prefix_avoided_prefill_tokens": avoided,
+            "migration_avoided_prefill_tokens": readmit,
+            "spec_verify_steps": verify,
+            "spec_accepted_tokens": accepted,
+            "tokens_per_verify":
+                (accepted / verify) if verify else None,
+            "spec_accept_hist": dict(sorted(hist.items())),
+        }
+
     def fleet_ttft(self) -> dict:
         """Fleet-wide TTFT distribution: the union of every replica's
         per-request TTFT samples."""
@@ -1011,6 +1044,7 @@ class FleetRouter:
                     (agg_useful / self.replica_steps)
                     if self.replica_steps else None,
                 "scale_events": [dict(e) for e in self.scale_events],
+                "cache_and_spec": self._cache_spec_aggregates(),
             },
             "replicas": {
                 f"replica{r.index}": {

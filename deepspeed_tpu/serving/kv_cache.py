@@ -32,7 +32,22 @@ into per-shard ranges so a shard_map over the slot axis sees only local
 blocks — the placement-semantics argument for why sharded decode moves
 zero collective bytes (see runtime/comm_accounting.
 serving_decode_collectives).
+
+Prefix caching (SGLang-style RadixAttention, arXiv 2312.07104): each
+shard additionally keeps a radix tree over block CONTENT — a node per
+physical block, keyed by the token tuple whose KV the block holds,
+chained parent→child in position order.  A new request walks the tree
+(:meth:`prefix_lookup`), maps every fully-matching block read-only into
+its own page table (:meth:`prefix_attach`, refcounted), and COW-splits
+the first divergent block: the partial match is device-copied into a
+private block the request may write into.  Completed prefills publish
+their prompt blocks back into the tree (:meth:`prefix_insert`).  Shared
+blocks are returned to the free list only when BOTH every mapping
+request has freed them AND the cache reclaims the node (LRU,
+unreferenced leaves first) — eviction never touches a block a live
+request still maps, and the trash block (0) is never cached.
 """
+import functools
 from typing import Dict, List, NamedTuple, Optional
 
 import numpy as np
@@ -43,6 +58,15 @@ import jax.numpy as jnp
 from deepspeed_tpu.utils.logging import logger
 
 TRASH_BLOCK = 0          # per-shard block 0 absorbs masked writes
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def _cow_copy_rows(arrs, src, dst):
+    """Copy one block's rows across every pool tensor (the COW split).
+    ``src``/``dst`` are TRACED scalars, so every (src, dst) pair reuses
+    ONE compiled program per pool shape — block churn never recompiles —
+    and the donated input keeps the copy allocation-free on the pool."""
+    return tuple(a.at[:, dst].set(a[:, src]) for a in arrs)
 
 
 class PoolTensors(NamedTuple):
@@ -57,6 +81,31 @@ class PoolTensors(NamedTuple):
     @property
     def arrays(self):
         return tuple(t for t in self if t is not None)
+
+
+class _PrefixNode:
+    """One physical block in a shard's prefix tree.  ``tokens`` is the
+    (≤ block_size) token tuple whose KV rows the block holds; ``refs``
+    counts live requests currently mapping the block read-only.  The
+    node itself keeps the block resident after refs drop to zero — that
+    is the cache — until LRU reclaim returns it to the free list."""
+    __slots__ = ("tokens", "block", "parent", "children", "refs", "tick")
+
+    def __init__(self, tokens, block, parent, tick):
+        self.tokens = tokens
+        self.block = block
+        self.parent = parent
+        self.children = {}
+        self.refs = 0
+        self.tick = tick
+
+
+def _common_prefix_len(a, b):
+    n = min(len(a), len(b))
+    for i in range(n):
+        if a[i] != b[i]:
+            return i
+    return n
 
 
 class PagedKVPool:
@@ -117,6 +166,19 @@ class PagedKVPool:
         self._shard_of: Dict[int, int] = {}
         self._positions: Dict[int, int] = {}       # rid -> covered positions
 
+        # prefix cache: per-shard radix tree over block content.  The
+        # sentinel roots hold no block; ``_nodes`` maps local block id ->
+        # node; ``_shared`` lists, per rid, the tree-owned blocks the rid
+        # maps read-only (free() derefs these instead of recycling them).
+        self._roots: List[_PrefixNode] = [
+            _PrefixNode((), None, None, 0) for _ in range(self.shards)]
+        self._nodes: List[Dict[int, _PrefixNode]] = [
+            {} for _ in range(self.shards)]
+        self._shared: Dict[int, List[int]] = {}
+        self._tick = 0
+        self.cow_splits = 0
+        self.cache_reclaims = 0
+
     # -- arming ---------------------------------------------------------
     def _arm_quantized_kv(self, requested):
         """int8 KV arms only where it actually saves bytes; every blocked
@@ -155,6 +217,8 @@ class PagedKVPool:
         prev = self._shard_of.setdefault(rid, shard)
         assert prev == shard, f"rid {rid} moved shards {prev}->{shard}"
         need = self.blocks_needed(n_positions) - len(have)
+        while need > len(self._free[shard]) and self._reclaim_block(shard):
+            pass
         if need > len(self._free[shard]):
             if not have:
                 self._drop(rid)
@@ -166,16 +230,28 @@ class PagedKVPool:
         return True
 
     def free(self, rid: int) -> None:
-        """Return every block of ``rid`` to its shard's free list."""
+        """Release every block of ``rid``: private blocks return to the
+        shard's free list; tree-owned (prefix-shared) blocks are DEREFED
+        instead — they stay resident in the cache until LRU reclaim."""
         blocks = self._blocks.pop(rid, [])
         shard = self._shard_of.pop(rid, 0)
         self._positions.pop(rid, None)
-        self._free[shard] = sorted(self._free[shard] + blocks)
+        shared = set(self._shared.pop(rid, ()))
+        nodes = self._nodes[shard]
+        recycled = []
+        for b in blocks:
+            node = nodes.get(b) if b in shared else None
+            if node is not None:
+                node.refs -= 1
+            else:
+                recycled.append(b)
+        self._free[shard] = sorted(self._free[shard] + recycled)
 
     def _drop(self, rid):
         self._blocks.pop(rid, None)
         self._shard_of.pop(rid, None)
         self._positions.pop(rid, None)
+        self._shared.pop(rid, None)
 
     def table_row(self, rid: int, width: int) -> np.ndarray:
         """LOCAL block ids of ``rid`` padded with the trash block to the
@@ -208,6 +284,162 @@ class PagedKVPool:
         payload size a KV handoff of this request would transfer."""
         return len(self._blocks.get(rid, ()))
 
+    # -- prefix cache (copy-on-write shared blocks) ---------------------
+    def _touch(self, node):
+        self._tick += 1
+        node.tick = self._tick
+
+    def prefix_lookup(self, shard: int, tokens) -> tuple:
+        """Walk ``shard``'s radix tree along ``tokens``.  Returns
+        ``(full_nodes, cow_node, cow_len)``: the chain of exactly-matching
+        full blocks, then the child sharing the longest strict prefix of
+        the next block (the COW-split candidate, ``cow_len`` trusted
+        positions).  Coverage is capped at ``len(tokens) - 1`` so the
+        final prompt position is always computed — the final prefill
+        chunk must still run to produce the first-token logits."""
+        bs = self.block_size
+        limit = len(tokens) - 1
+        node = self._roots[shard]
+        full = []
+        pos = 0
+        while pos + bs <= limit:
+            key = tuple(int(t) for t in tokens[pos:pos + bs])
+            child = node.children.get(key)
+            if child is None:
+                break
+            full.append(child)
+            node = child
+            pos += bs
+        rest = tuple(int(t) for t in tokens[pos:min(pos + bs, limit)])
+        cow, cow_len = None, 0
+        for child in node.children.values():
+            p = _common_prefix_len(child.tokens, rest)
+            if p > cow_len:
+                cow, cow_len = child, p
+        return full, cow, cow_len
+
+    def prefix_attach(self, rid: int, shard: int, tokens) -> int:
+        """Map the longest cached prefix of ``tokens`` into ``rid``'s
+        (empty) page table: fully-matching blocks are shared read-only
+        (refcounted); the first divergent block is COW-split — its
+        trusted prefix rows are device-copied into a private block the
+        request may write into.  Returns the number of positions covered,
+        which the request's prefill can skip entirely."""
+        assert not self._blocks.get(rid), \
+            f"prefix_attach on rid {rid} with blocks already allocated"
+        full, cow, cow_len = self.prefix_lookup(shard, tokens)
+        if not full and cow_len == 0:
+            return 0
+        covered = len(full) * self.block_size
+        blocks = []
+        for node in full:
+            node.refs += 1
+            self._touch(node)
+            blocks.append(node.block)
+        if cow is not None and cow_len > 0:
+            if not self._free[shard]:
+                self._reclaim_block(shard)
+            if self._free[shard]:
+                dst = self._free[shard].pop(0)
+                self._cow_copy(shard, cow.block, dst)
+                self._touch(cow)
+                blocks.append(dst)
+                covered += cow_len
+                self.cow_splits += 1
+        self._blocks[rid] = blocks
+        self._shard_of[rid] = shard
+        self._positions[rid] = covered
+        self._shared[rid] = [n.block for n in full]
+        return covered
+
+    def prefix_insert(self, rid: int, shard: int, tokens) -> int:
+        """Publish ``rid``'s prompt blocks into ``shard``'s radix tree so
+        later requests can share them.  Blocks already attached from the
+        tree descend without re-insertion; content already cached under a
+        DIFFERENT physical block keeps the existing entry (rid's copy
+        stays private).  Returns the number of blocks newly shared."""
+        bs = self.block_size
+        blocks = self._blocks.get(rid, [])
+        node = self._roots[shard]
+        nodes = self._nodes[shard]
+        inserted = 0
+        pos = 0
+        i = 0
+        n = len(tokens)
+        while pos < n and i < len(blocks):
+            chunk = tuple(int(t) for t in tokens[pos:pos + bs])
+            child = node.children.get(chunk)
+            if child is not None:
+                node = child          # cached already (ours or a twin's)
+                self._touch(node)
+            else:
+                blk = blocks[i]
+                if blk in nodes:      # block published by an earlier
+                    break             # insert of this rid under another
+                                      # key — never double-own a block
+                child = _PrefixNode(chunk, blk, node, 0)
+                child.refs = 1        # rid still maps it
+                node.children[chunk] = child
+                nodes[blk] = child
+                self._touch(child)
+                self._shared.setdefault(rid, []).append(blk)
+                node = child
+                inserted += 1
+            pos += bs
+            i += 1
+        return inserted
+
+    def _cow_copy(self, shard: int, src: int, dst: int) -> None:
+        """Device-side copy of one block's rows (the COW split): global
+        ids address the unsplit block axis, exactly like the KV-handoff
+        scatter, and the result is re-pinned to the pool's sharding so
+        the donated dispatch path sees identically-placed arrays."""
+        base = shard * self.blocks_per_shard
+        g_src, g_dst = np.int32(base + src), np.int32(base + dst)
+        arrs = _cow_copy_rows(self.tensors.arrays, g_src, g_dst)
+        if self.mesh is not None and self.shards > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            spec = NamedSharding(self.mesh, P(None, self.axis_name))
+            arrs = tuple(jax.device_put(a, spec) for a in arrs)
+        it = iter(arrs)
+        self.tensors = PoolTensors(*(next(it) if t is not None else None
+                                     for t in self.tensors))
+
+    def warm_cow(self) -> None:
+        """Compile the COW-split copy program up front (a trash-block
+        self-copy — bit-neutral) so the first REAL split inside a
+        recompile-guard window compiles nothing."""
+        self._cow_copy(0, TRASH_BLOCK, TRASH_BLOCK)
+
+    def _reclaim_block(self, shard: int) -> bool:
+        """Evict ONE least-recently-used unreferenced leaf node from the
+        shard's prefix tree, returning its block to the free list.
+        Blocks still mapped by a live request (refs > 0) are never
+        reclaimed — eviction respects refcounts."""
+        best = None
+        stack = [self._roots[shard]]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if (node.block is not None and not node.children
+                    and node.refs <= 0):
+                if best is None or node.tick < best.tick:
+                    best = node
+        if best is None:
+            return False
+        del best.parent.children[best.tokens]
+        self._nodes[shard].pop(best.block, None)
+        self._free[shard] = sorted(self._free[shard] + [best.block])
+        self.cache_reclaims += 1
+        return True
+
+    def cached_blocks(self, shard: Optional[int] = None) -> int:
+        """Blocks currently owned by the prefix tree (shared + resident)."""
+        if shard is not None:
+            return len(self._nodes[shard])
+        return sum(len(n) for n in self._nodes)
+
     # -- accounting -----------------------------------------------------
     def device_bytes(self) -> int:
         """Per-shard device bytes of the pool tensors, priced through
@@ -228,16 +460,23 @@ class PagedKVPool:
 
     @property
     def blocks_in_use(self) -> int:
-        return sum(len(b) for b in self._blocks.values())
+        """DISTINCT blocks not on a free list — refcount-shared blocks
+        count ONCE no matter how many page tables map them, and
+        cache-resident blocks (refs == 0, awaiting reclaim) count too:
+        they genuinely occupy pool capacity."""
+        return self.usable_blocks - sum(len(f) for f in self._free)
 
     def occupancy(self) -> float:
         return self.blocks_in_use / max(1, self.usable_blocks)
 
     def fragmentation(self) -> float:
-        """Internal fragmentation: fraction of ALLOCATED pool positions
-        not covered by live tokens (tail slack of each sequence's last
-        block).  0 = every allocated slot holds a token."""
-        allocated = self.blocks_in_use * self.block_size
+        """Internal fragmentation: fraction of MAPPED pool positions not
+        covered by live tokens (tail slack of each sequence's last
+        block).  Shared blocks appear once per mapping request on both
+        sides of the ratio, so this stays a pure slack measure under
+        prefix sharing.  0 = every mapped slot holds a token."""
+        allocated = sum(len(b) for b in self._blocks.values()) \
+            * self.block_size
         if allocated == 0:
             return 0.0
         used = sum(self._positions.values())
@@ -254,4 +493,9 @@ class PagedKVPool:
             "shards": self.shards,
             "quantized": self.quantized,
             "free_per_shard": [len(f) for f in self._free],
+            "prefix_cached_blocks": self.cached_blocks(),
+            "prefix_shared_refs": sum(
+                n.refs for nodes in self._nodes for n in nodes.values()),
+            "prefix_cow_splits": self.cow_splits,
+            "prefix_cache_reclaims": self.cache_reclaims,
         }

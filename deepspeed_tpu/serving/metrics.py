@@ -111,6 +111,17 @@ class ServingMetrics:
         self._t0 = None
         self._t_end = None
         self._step_dt_ema = None       # EMA of inter-step wall time
+        # prefix cache (ISSUE 17): admission-time tree consults
+        self.prefill_computed_tokens = 0   # positions actually dispatched
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefix_avoided_tokens = 0     # positions served from cache
+        self.readmit_avoided_tokens = 0    # of those: journal-replay /
+        #                                    migration re-submissions
+        # speculative decoding (ISSUE 17): draft-verify accounting
+        self.spec_verify_steps = 0         # verify dispatches (lane-steps)
+        self.spec_accepted_tokens = 0      # tokens delivered by verifies
+        self.spec_accept_hist: Dict[int, int] = {}  # accepted-length counts
 
     # -- request lifecycle ---------------------------------------------
     def record_submit(self, rid):
@@ -150,6 +161,48 @@ class ServingMetrics:
 
     def record_eviction(self, rid):
         self.evictions += 1
+
+    def record_prefill(self, n_tokens):
+        """Prefill positions actually DISPATCHED to the device — the
+        numerator the prefix-cache ratio guard compares across cache
+        on/off runs (cached positions never reach this counter)."""
+        self.prefill_computed_tokens += int(n_tokens)
+
+    def record_prefix_lookup(self, avoided_tokens, *, readmit=False):
+        """One admission-time prefix-tree consult; ``avoided_tokens`` is
+        the number of prompt positions served from cache (0 = miss).
+        ``readmit`` marks journal-replay/migration re-submissions —
+        counted separately so ``fleet_report()`` can attribute the
+        recovery-path savings honestly."""
+        self.prefix_lookups += 1
+        if avoided_tokens > 0:
+            self.prefix_hits += 1
+            self.prefix_avoided_tokens += int(avoided_tokens)
+            if readmit:
+                self.readmit_avoided_tokens += int(avoided_tokens)
+
+    def record_verify(self, accepted, lanes=1):
+        """One speculative verify outcome per lane: ``accepted`` tokens
+        (1..draft_len+1) were delivered by a single batched dispatch."""
+        self.spec_verify_steps += int(lanes)
+        self.spec_accepted_tokens += int(accepted)
+        self.spec_accept_hist[int(accepted)] = \
+            self.spec_accept_hist.get(int(accepted), 0) + 1
+
+    def tokens_per_verify(self):
+        """Mean tokens delivered per speculative verify dispatch (the
+        speedup signal: 1.0 = speculation never helps).  None before the
+        first verify."""
+        if not self.spec_verify_steps:
+            return None
+        return self.spec_accepted_tokens / self.spec_verify_steps
+
+    def prefix_hit_rate(self):
+        """Fraction of admission-time prefix lookups that found cached
+        blocks.  None before the first lookup (honest gap, not 0)."""
+        if not self.prefix_lookups:
+            return None
+        return self.prefix_hits / self.prefix_lookups
 
     # -- per step -------------------------------------------------------
     def record_step(self, *, queue_depth, running, slots, occupancy,
@@ -253,6 +306,22 @@ class ServingMetrics:
                 if self.slot_steps else None,
             },
             "steps": {"total": self.steps, "decode": self.decode_steps},
+            "prefix_cache": {
+                "lookups": self.prefix_lookups,
+                "hits": self.prefix_hits,
+                "hit_rate": self.prefix_hit_rate(),
+                "avoided_prefill_tokens": self.prefix_avoided_tokens,
+                "readmit_avoided_prefill_tokens":
+                    self.readmit_avoided_tokens,
+                "prefill_tokens_computed": self.prefill_computed_tokens,
+            },
+            "speculative": {
+                "verify_steps": self.spec_verify_steps,
+                "accepted_tokens": self.spec_accepted_tokens,
+                "tokens_per_verify": self.tokens_per_verify(),
+                "accept_len_hist": dict(sorted(
+                    self.spec_accept_hist.items())),
+            },
             "queue_depth": {"mean": self._queue_depth.mean(),
                             "max": self._queue_depth.max()
                             if self._queue_depth.count else 0,

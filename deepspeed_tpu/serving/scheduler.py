@@ -183,10 +183,17 @@ class Scheduler:
     # -- slots ----------------------------------------------------------
     # the engine installs a ranker so admission steers toward the slot
     # whose pool shard has the most free blocks (ties -> lowest slot);
-    # without one, first-free wins
+    # with a candidate request the ranker also sees it, so prefix-cache
+    # placement can prefer the shard already holding the prompt's KV;
+    # without a ranker, first-free wins
     slot_ranker = None
+    # the engine installs a probe that consults the pool's prefix tree at
+    # admission time: cached prompt blocks are mapped read-only into the
+    # new request's page table and its ``prefill_done`` advances past
+    # them, so the engine skips the covered prefill chunks entirely
+    prefix_probe = None
 
-    def free_slot(self) -> Optional[int]:
+    def free_slot(self, req: Optional[Request] = None) -> Optional[int]:
         taken = set(self.running)
         if self.prefilling is not None and self.prefilling.slot is not None:
             taken.add(self.prefilling.slot)
@@ -195,7 +202,7 @@ class Scheduler:
             return None
         if self.slot_ranker is None:
             return free[0]
-        return max(free, key=lambda s: (self.slot_ranker(s), -s))
+        return max(free, key=lambda s: (self.slot_ranker(s, req), -s))
 
     def may_admit(self) -> bool:
         if self.draining:
@@ -217,7 +224,7 @@ class Scheduler:
         candidate, or the static gate is closed."""
         if self.prefilling is not None or not self.may_admit():
             return None
-        slot = self.free_slot()
+        slot = self.free_slot(self.peek_waiting())
         if slot is None:
             return None
         req = self._pop_waiting()
@@ -232,6 +239,10 @@ class Scheduler:
         req.state = RequestState.PREFILL
         req.slot = slot
         self.prefilling = req
+        if self.prefix_probe is not None:
+            # admission consults the prefix tree: cached prompt blocks
+            # are attached read-only and their prefill chunks skipped
+            self.prefix_probe(req)
         return req
 
     def promote(self, req: Request) -> None:

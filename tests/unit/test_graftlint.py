@@ -1334,3 +1334,170 @@ def test_disarmed_discipline_covers_arm_memory_accounting():
     assert rule_names(got) == ["disarmed-discipline"]
     assert "_arm_memory_accounting" in got[0].message
     assert lint(ARM_MEMORY_GOOD, rules=["disarmed-discipline"]) == []
+
+
+# ---------------------------------------------------------------------------
+# rule: host-sync — prefix cache + speculative decode (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+HS_RADIX_WALK_BAD = """
+class PagedKVPool:
+    def prefix_attach(self, rid, shard, tokens):
+        blocks = []
+        for node in self.prefix_lookup(shard, tokens)[0]:
+            node.refs += 1
+            jax.device_get(self.tensors.k[:, node.block])
+            blocks.append(node.block)
+        return blocks
+"""
+
+HS_COW_SPLIT_BAD = """
+class PagedKVPool:
+    def _cow_copy(self, shard, src, dst):
+        arrs = _cow_copy_rows(self.tensors.arrays, src, dst)
+        for a in arrs:
+            a.block_until_ready()
+        self.tensors = PoolTensors(*arrs)
+"""
+
+HS_RECLAIM_BAD = """
+class PagedKVPool:
+    def _reclaim_block(self, shard):
+        while self._lru:
+            node = self._lru.pop()
+            if float(jax.device_get(node.score)) > 0:
+                continue
+            return node.block
+"""
+
+HS_DRAFT_BAD = """
+class InferenceEngine:
+    def _spec_decode_tick(self, events):
+        for slot, req in self.scheduler.running.items():
+            drafts = self._draft_tokens(req, self.spec_k)
+            tok = int(jax.device_get(self._nxt[slot]))
+            req.generated.append(tok)
+"""
+
+HS_PREFIX_SPEC_GOOD = """
+class PagedKVPool:
+    def prefix_attach(self, rid, shard, tokens):
+        full, cow, cow_len = self.prefix_lookup(shard, tokens)
+        blocks = []
+        for node in full:
+            node.refs += 1
+            blocks.append(node.block)
+        if cow is not None and cow_len > 0:
+            self._cow_copy(shard, cow.block, blocks[-1])
+        return blocks
+
+    def _cow_copy(self, shard, src, dst):
+        self.tensors = PoolTensors(
+            *_cow_copy_rows(self.tensors.arrays, src, dst))
+
+
+class InferenceEngine:
+    def _spec_decode_tick(self, events):
+        out = self._spec(self.params, self._tables)
+        outs, fins = jax.device_get((out[-2], out[-1]))
+        for slot, req in self.scheduler.running.items():
+            req.generated.append(int(outs[slot, 0]))
+"""
+
+
+@pytest.mark.parametrize("src,label", [
+    (HS_RADIX_WALK_BAD, "prefix_attach"),
+    (HS_COW_SPLIT_BAD, "_cow_copy"),
+    (HS_RECLAIM_BAD, "_reclaim_block"),
+])
+def test_host_sync_covers_radix_cow_refcount_fns(src, label):
+    """ISSUE 17 satellite: the radix walk, COW split and LRU reclaim run
+    at admission over every request — a device sync per tree node (or a
+    block on the COW copy) fires; the single jitted copy dispatch and
+    host-only refcount bookkeeping stay quiet."""
+    path = "deepspeed_tpu/serving/kv_cache.py"
+    got = lint(src, path, rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"], (label, path)
+    # scoped: the same walk in a test file is not a hot path
+    assert lint(src, "tests/unit/t.py", rules=["host-sync"]) == []
+
+
+def test_host_sync_covers_draft_verify_tick():
+    """The draft-verify tick is held to the decode bar: a per-lane fetch
+    fires; drafting + ONE batched fetch after the dispatch is quiet."""
+    path = "deepspeed_tpu/serving/engine.py"
+    got = lint(HS_DRAFT_BAD, path, rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"]
+    assert lint(HS_PREFIX_SPEC_GOOD, path, rules=["host-sync"]) == []
+    assert lint(HS_PREFIX_SPEC_GOOD,
+                "deepspeed_tpu/serving/kv_cache.py",
+                rules=["host-sync"]) == []
+
+
+# ---------------------------------------------------------------------------
+# rule: disarmed-discipline — cache/spec arming pairs (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+DISARM_PREFIX_CACHE_BAD = """
+class InferenceEngine:
+    def _arm_prefix_cache(self, requested, quantize_kv):
+        if not requested:
+            return False
+        if quantize_kv and not self.pool.quantized:
+            return False
+        return True
+"""
+
+DISARM_PREFIX_CACHE_GOOD = """
+class InferenceEngine:
+    def _arm_prefix_cache(self, requested, quantize_kv):
+        if not requested:
+            return False
+        if quantize_kv and not self.pool.quantized:
+            logger.warning("prefix cache: DISARMED - int8 KV was "
+                           "requested but the pool disarmed it "
+                           "(off-profitability)")
+            return False
+        if self.scheduler.draining:
+            logger.warning("prefix cache: DISARMED - draining engine "
+                           "admits nothing, the tree would pin blocks")
+            return False
+        return True
+"""
+
+DISARM_SPEC_BAD = """
+class InferenceEngine:
+    def _arm_speculative(self, spec):
+        if not spec or self.temperature != 0.0:
+            return 0
+        return int(spec)
+"""
+
+DISARM_SPEC_GOOD = """
+class InferenceEngine:
+    def _arm_speculative(self, spec):
+        if not spec:
+            return 0
+        if self.temperature != 0.0:
+            logger.warning("speculative decoding: DISARMED - sampling "
+                           "!= greedy: the acceptance rule is only "
+                           "defined at temperature=0")
+            return 0
+        return int(spec)
+"""
+
+
+@pytest.mark.parametrize("bad,good", [
+    (DISARM_PREFIX_CACHE_BAD, DISARM_PREFIX_CACHE_GOOD),
+    (DISARM_SPEC_BAD, DISARM_SPEC_GOOD),
+])
+def test_disarmed_discipline_cache_and_spec_arming(bad, good):
+    """ISSUE 17 satellite: the cache/spec arming decisions follow the
+    armed-or-warns discipline — silently refusing a requested feature
+    fires; a DISARMED warn naming the blocker (sampling != greedy,
+    int8-off-profitability, draining) is quiet."""
+    path = "deepspeed_tpu/serving/engine.py"
+    assert rule_names(lint(bad, path,
+                           rules=["disarmed-discipline"])) \
+        == ["disarmed-discipline"]
+    assert lint(good, path, rules=["disarmed-discipline"]) == []

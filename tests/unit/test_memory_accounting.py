@@ -195,6 +195,31 @@ def test_kv_pool_bytes_exact_vs_allocated_pool():
         assert pool.stats()["pool_device_bytes"] == actual
 
 
+def test_kv_pool_bytes_prices_shared_blocks_once():
+    """ISSUE 17: under prefix sharing, a logical demand of N blocks
+    where S blocks carry R references each needs only
+    N - S*(R-1) physical blocks — shared storage is priced ONCE, and
+    the no-sharing defaults reproduce the un-extended builder exactly
+    (the checked-in budget entries must not move)."""
+    from deepspeed_tpu.runtime.memory_accounting import kv_pool_bytes
+
+    base = dict(n_layer=2, n_head=4, block_size=4, head_dim=8,
+                kv_dtype="bfloat16")
+    for quant in (False, True):
+        plain = kv_pool_bytes(2, 64, 4, 4, 8, kv_dtype="bfloat16",
+                              quantized=quant)
+        shared = kv_pool_bytes(2, 64, 4, 4, 8, kv_dtype="bfloat16",
+                               quantized=quant, shared_blocks=8,
+                               shared_refs=5)
+        physical = kv_pool_bytes(2, 64 - 8 * 4, 4, 4, 8,
+                                 kv_dtype="bfloat16", quantized=quant)
+        assert shared == physical < plain, (quant, base)
+        # shared_refs=1 (nothing actually shared) is the identity
+        assert kv_pool_bytes(2, 64, 4, 4, 8, kv_dtype="bfloat16",
+                             quantized=quant, shared_blocks=8,
+                             shared_refs=1) == plain
+
+
 # ---------------------------------------------------------------------------
 # engine integration
 # ---------------------------------------------------------------------------

@@ -555,3 +555,306 @@ def test_continuous_beats_static_batching(toy):
 
     cont, static = run("continuous"), run("static")
     assert cont >= 1.3 * static, (cont, static)
+
+
+# ---------------------------------------------------------------------------
+# prefix cache + speculative decode (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def _shared_prefix_prompts(seed, n, prefix_len=16, tail=(2, 5)):
+    """System-prompt traffic in miniature: one shared prefix, short
+    random tails — the serve_bench ``shared-prefix`` shape."""
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(0, 97, prefix_len).astype(np.int32)
+    return [np.concatenate(
+        [prefix, rng.integers(0, 97,
+                              int(rng.integers(*tail))).astype(np.int32)])
+        for _ in range(n)]
+
+
+@pytest.mark.parametrize("cache,spec", [(True, None), (False, 3),
+                                        (True, 3)])
+def test_parity_cache_and_spec_matrix(toy, cache, spec):
+    """THE acceptance parity: greedy tokens with the prefix cache and/or
+    speculative decoding armed are BIT-IDENTICAL to single-sequence
+    generate() under staggered arrivals on shared-prefix traffic (the
+    cache-off/spec-off cell is the existing staggered parity test)."""
+    model, params, ref = toy
+    eng = _engine(model, params, prefix_cache=cache, speculative=spec)
+    prompts = _shared_prefix_prompts(21, 5)
+    maxnew = [6, 9, 4, 7, 5]
+    rids = []
+    for p, m in zip(prompts, maxnew):
+        rids.append(eng.submit(p, max_new_tokens=m))
+        eng.step()                        # stagger arrivals
+        eng.step()
+    res = eng.serve(max_steps=500)
+    for rid, p, m in zip(rids, prompts, maxnew):
+        np.testing.assert_array_equal(res[rid]["tokens"], ref(p, m))
+    rep = eng.serving_report()
+    if cache:
+        assert rep["prefix_cache"]["hits"] >= 1
+        assert rep["prefix_cache"]["avoided_prefill_tokens"] > 0
+    if spec:
+        assert rep["speculative"]["verify_steps"] > 0
+        assert sum(k * v for k, v in
+                   rep["speculative"]["accept_len_hist"].items()) \
+            == rep["speculative"]["accepted_tokens"]
+
+
+def test_prefix_cache_prefill_ratio_guard(toy):
+    """The serve_bench shared-prefix gate in miniature (tier-1, like the
+    1.3x continuous-batching guard): the radix cache computes >= 2x
+    fewer prefill tokens than the cache-off run of the SAME traffic."""
+    model, params, ref = toy
+    prompts = _shared_prefix_prompts(22, 6)
+    maxnew = [4, 6, 3, 5, 4, 6]
+
+    def run(cache):
+        eng = _engine(model, params, prefix_cache=cache)
+        rids = [eng.submit(p, max_new_tokens=m)
+                for p, m in zip(prompts, maxnew)]
+        res = eng.serve(max_steps=500)
+        for rid, p, m in zip(rids, prompts, maxnew):
+            np.testing.assert_array_equal(res[rid]["tokens"], ref(p, m))
+        return eng.metrics.prefill_computed_tokens
+
+    computed_off, computed_on = run(False), run(True)
+    assert computed_off == sum(len(p) for p in prompts)
+    assert computed_off >= 2 * computed_on, (computed_off, computed_on)
+
+
+def test_prefix_cache_parity_under_shared_block_eviction(toy):
+    """A pool too small for the working set forces eviction while shared
+    blocks are live: refcounted tree blocks survive their owner's
+    eviction (the re-prefill re-attaches them), COW splits keep private
+    writes off shared storage, and every token stays bit-identical."""
+    model, params, ref = toy
+    eng = _engine(model, params, max_slots=2, kv_blocks=10,
+                  prefix_cache=True)
+    # prefix 10 = 2 full shareable blocks + a 2-position COW overlap;
+    # cheap admits, then 16-token continuations outgrow the pool
+    prompts = _shared_prefix_prompts(23, 4, prefix_len=10, tail=(2, 4))
+    rids = [eng.submit(p, max_new_tokens=16) for p in prompts]
+    res = eng.serve(max_steps=800)
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(res[rid]["tokens"], ref(p, 16))
+    rep = eng.serving_report()
+    assert rep["requests"]["evictions"] >= 1, \
+        "pool sizing failed to exercise eviction under sharing"
+    assert rep["prefix_cache"]["hits"] >= 1
+    assert rep["kv_pool"]["now"]["prefix_cow_splits"] >= 1
+
+
+def test_pool_radix_refcount_cow_and_reclaim():
+    """Radix-tree unit semantics: exact-match sharing, COW split of the
+    divergent block, refcounts pinning shared blocks across free(), and
+    LRU reclaim returning unreferenced leaves to the allocator."""
+    cfg = GPT2Config(vocab_size=32, n_positions=64, n_embd=8, n_layer=1,
+                     n_head=2, dtype=jnp.float32, loss_chunk_tokens=0)
+    pool = PagedKVPool(cfg, num_blocks=10, block_size=4)
+    toks0 = tuple(range(12))              # 3 full blocks
+    assert pool.alloc(0, 0, 12)
+    assert pool.prefix_insert(0, 0, toks0) == 3
+    assert pool.cached_blocks() == 3
+
+    # divergence inside block 3: two full matches + a 2-position COW
+    toks1 = toks0[:10] + (31, 30)
+    full, cow, cow_len = pool.prefix_lookup(0, toks1)
+    assert len(full) == 2 and cow is not None and cow_len == 2
+    assert pool.prefix_attach(1, 0, toks1) == 10
+    assert pool.cow_splits == 1
+    assert pool.blocks_of(1) == 3         # 2 shared + 1 private COW
+    assert pool.alloc(1, 0, 14)           # extend for the un-cached tail
+
+    # freeing the inserter must NOT recycle tree-owned blocks…
+    in_use = pool.blocks_in_use
+    pool.free(0)
+    assert pool.blocks_in_use == in_use   # all 3 were tree-owned
+    # …and rid1 still decodes against the shared storage
+    assert pool.table_row(1, 4)[0] != 0
+    pool.free(1)                          # derefs shares, recycles COW
+
+    # allocator pressure reclaims unreferenced LRU leaves, never more
+    assert pool.cache_reclaims == 0
+    assert pool.alloc(2, 0, 36)           # 9 blocks: needs the tree's 3
+    assert pool.cache_reclaims == 3
+    assert pool.cached_blocks() == 0
+    stats = pool.stats()
+    assert stats["prefix_cow_splits"] == 1
+    assert stats["prefix_cache_reclaims"] == 3
+
+
+def test_parity_chaos_cancel_mid_draft(toy):
+    """chaos cancellation landing between draft and verify: survivors
+    stay bit-identical, cancelled requests report a clean prefix of the
+    reference continuation (no half-accepted draft garbage)."""
+    model, params, ref = toy
+    eng = _engine(model, params, prefix_cache=True, speculative=3)
+    prompts = _shared_prefix_prompts(24, 5, prefix_len=12)
+    maxnew = [6, 9, 12, 5, 8]
+    chaos.arm(cancel_request_every=7)
+    try:
+        rids = []
+        for p, m in zip(prompts, maxnew):
+            rids.append(eng.submit(p, max_new_tokens=m))
+            eng.step()
+            eng.step()
+        res = eng.serve(max_steps=500)
+    finally:
+        plan = chaos.active()
+        chaos.disarm()
+    assert any(kind == "cancel_request" for kind, _ in plan.fired)
+    assert eng.metrics.spec_verify_steps > 0
+    finished = cancelled = 0
+    for rid, p, m in zip(rids, prompts, maxnew):
+        r = res[rid]
+        if r["status"] == "cancelled":
+            cancelled += 1
+            np.testing.assert_array_equal(
+                r["tokens"], ref(p, m)[:len(r["tokens"])])
+        else:
+            finished += 1
+            np.testing.assert_array_equal(r["tokens"], ref(p, m))
+    assert cancelled >= 1 and finished >= 1
+
+
+def test_spec_acceptance_histogram_rigged_drafter(toy):
+    """Histogram correctness on rigged drafters: an oracle drafter
+    accepts full k+1 windows (modulo request-budget tails); a constant
+    drafter degrades toward 1 token/verify — and BOTH stay
+    bit-identical, because acceptance re-verifies every draft."""
+    model, params, ref = toy
+    prompts = _prompts(25, (5, 7, 4))
+    maxnew = [9, 8, 10]
+
+    def run(drafter):
+        eng = _engine(model, params, speculative=3)
+        if drafter == "oracle":
+            def draft(req, k):
+                full = ref(req.prompt, req.max_new_tokens)
+                done = len(req.full_tokens)
+                nxt = [int(t) for t in full[done:done + k]]
+                while len(nxt) < k:
+                    nxt.append(int(full[-1]))
+                return nxt
+            eng._draft_tokens = draft
+        elif drafter == "constant":
+            eng._draft_tokens = lambda req, k: [96] * k
+        rids = [eng.submit(p, max_new_tokens=m)
+                for p, m in zip(prompts, maxnew)]
+        res = eng.serve(max_steps=500)
+        for rid, p, m in zip(rids, prompts, maxnew):
+            np.testing.assert_array_equal(res[rid]["tokens"], ref(p, m))
+        hist = dict(eng.metrics.spec_accept_hist)
+        # each request's FIRST token comes from the final prefill chunk,
+        # so verify steps deliver max_new - 1 tokens per request
+        assert sum(k * v for k, v in hist.items()) \
+            == eng.metrics.spec_accepted_tokens \
+            == sum(maxnew) - len(prompts)
+        return hist, eng.metrics.tokens_per_verify()
+
+    hist_o, tpv_o = run("oracle")
+    hist_c, tpv_c = run("constant")
+    assert max(hist_o) == 4, hist_o       # full k+1 windows accepted
+    assert tpv_o > 2.0, (hist_o, tpv_o)
+    assert hist_c.get(1, 0) > 0
+    assert tpv_o > tpv_c, (tpv_o, tpv_c)
+
+
+def test_zero_recompiles_with_cache_and_spec(toy):
+    """The ISSUE 17 recompile pin: join/leave churn with the prefix
+    cache AND speculative decoding armed compiles NOTHING after warmup
+    (COW splits included), and the draft-verify program honors the
+    decode jit's HLO contracts (host-transfer-free, pool donated)."""
+    from tools.graftlint import hlo_contracts as hc
+
+    model, params, ref = toy
+    eng = _engine(model, params, prefix_cache=True, speculative=3)
+    eng.warmup()
+    # prefix 14 = 3 full shareable blocks + a 2-position COW overlap,
+    # so the guard window provably contains a COW device copy
+    prompts = _shared_prefix_prompts(26, 6, prefix_len=14)
+    maxnew = [6, 9, 12, 5, 8, 7]
+    with CompilationCounter() as cc:
+        rids = []
+        for p, m in zip(prompts, maxnew):
+            rids.append(eng.submit(p, max_new_tokens=m))
+            eng.step()
+            eng.step()
+        eng.serve(max_steps=500)
+    assert cc.count == 0, \
+        f"{cc.count} XLA compilations during cache+spec churn"
+    assert eng.pool.cow_splits >= 1, \
+        "churn never exercised a COW split inside the guard window"
+    for rid, p, m in zip(rids, prompts, maxnew):
+        np.testing.assert_array_equal(eng.results[rid]["tokens"],
+                                      ref(p, m))
+    hlo = eng.spec_hlo()
+    hc.assert_no_host_transfers(hlo, "serving draft-verify step")
+    nleaves = len(jax.tree_util.tree_leaves(params))
+    hc.assert_donates(hlo, range(nleaves, nleaves + eng.n_pool_tensors()),
+                      "serving draft-verify step")
+
+
+def test_spec_disarms_on_sampling(toy, caplog):
+    """temperature > 0 breaks the bit-identical-greedy acceptance rule:
+    speculation must warn DISARMED (naming sampling) and serve the
+    plain decode jit."""
+    import logging
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    model, params, _ = toy
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            eng = _engine(model, params, speculative=3, temperature=0.7,
+                          top_k=5)
+    finally:
+        ds_logger.propagate = False
+    assert eng.spec_k == 0 and eng._spec is None
+    assert any("DISARMED" in r.message and "temperature" in r.message
+               for r in caplog.records)
+
+
+def test_prefix_cache_disarm_blockers(toy, caplog):
+    """The cache's DISARM warns name their blockers: an int8-KV ask the
+    pool itself disarmed (off-profitability), and a draining engine
+    whose closed admission could never consult the tree."""
+    import logging
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    cfg = GPT2Config(vocab_size=32, n_positions=32, n_embd=8, n_layer=1,
+                     n_head=2, dtype=jnp.bfloat16, loss_chunk_tokens=0)
+    model = GPT2Model(cfg)
+    ids = np.random.default_rng(0).integers(0, 32, (2, 8))
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": ids, "labels": ids})
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            eng = InferenceEngine(model, params, max_slots=2,
+                                  kv_block_size=4, prefill_chunk=8,
+                                  max_blocks_per_seq=4,
+                                  quantize_kv=True, prefix_cache=True)
+    finally:
+        ds_logger.propagate = False
+    assert not eng.pool.quantized and not eng.prefix_cache
+    assert any("DISARMED" in r.message and "int8" in r.message
+               for r in caplog.records)
+
+    model3, params3, _ = toy
+    eng3 = _engine(model3, params3)
+    eng3.scheduler.draining = True
+    caplog.clear()
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            armed = eng3._arm_prefix_cache(True, False)
+    finally:
+        ds_logger.propagate = False
+    assert not armed
+    assert any("DISARMED" in r.message and "draining" in r.message
+               for r in caplog.records)

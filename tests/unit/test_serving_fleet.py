@@ -996,3 +996,57 @@ def test_fleet_real_sigkill_peer_migrates_journal_zero_lost(toy, tmp_path):
     assert rep["replicas"]["replica1"]["state"] == REPLICA_DEAD
     assert rep["config"]["transport_armed"]
     assert 2 not in tr.describe()["alive"]
+
+
+# ---------------------------------------------------------------------------
+# prefix-cache / spec-decode honesty across migration (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def test_fleet_migration_hits_prefix_cache_bit_identical(toy, tmp_path):
+    """Cache honesty across failure: with the prefix cache and
+    speculative decoding armed fleet-wide, killing a replica re-places
+    its journal-live requests through the NORMAL admission probe — the
+    re-prefill skips every cached block (counted as
+    migration_avoided_prefill_tokens in fleet_report()), continuations
+    stay bit-identical, and the router's _last_metrics carries the
+    fleet-wide hit rate / avoided tokens / tokens-per-verify /
+    acceptance histogram."""
+    model, params, ref = toy
+    clock = StepClock()
+    r = _fleet(model, params, replicas=3, clock=clock,
+               journal_dir=tmp_path,
+               config={"max_consecutive_failures": 2,
+                       "retry_backoff_steps": 2},
+               prefix_cache=True, speculative=3)
+    r.warmup()
+    rng = np.random.default_rng(7)
+    pre = rng.integers(0, 97, 12).astype(np.int32)
+    prompts = [np.concatenate(
+        [pre, rng.integers(0, 97, k).astype(np.int32)])
+        for k in (3, 5, 2, 4, 6, 3)]
+    maxnew = [6, 8, 5, 7, 6, 9]
+    rids = [r.submit(p, max_new_tokens=m, replica=i % 3)
+            for i, (p, m) in enumerate(zip(prompts, maxnew))]
+    chaos.arm(kill_replica_after_steps=5, kill_replica=1)
+    try:
+        events = _drive(r, clock, max_steps=200)
+    finally:
+        chaos.disarm()
+    assert r.replicas[1].state == REPLICA_DEAD
+    assert any(e["migrated"] for e in events)
+    for rid, p, m in zip(rids, prompts, maxnew):
+        np.testing.assert_array_equal(r.results[rid]["tokens"],
+                                      ref(p, m))
+    agg = r.fleet_report()["router"]["cache_and_spec"]
+    assert agg["prefix_hits"] >= 1
+    assert agg["prefix_avoided_prefill_tokens"] > 0
+    assert agg["migration_avoided_prefill_tokens"] > 0, \
+        "migrated requests re-prefilled from token 0 past a warm cache"
+    assert agg["spec_verify_steps"] > 0
+    assert sum(k * v for k, v in agg["spec_accept_hist"].items()) \
+        == agg["spec_accepted_tokens"]
+    flat = r.telemetry_report()["replica_metrics"]
+    for key in ("router/prefix_hit_rate",
+                "router/prefix_avoided_prefill_tokens",
+                "router/tokens_per_verify", "router/spec_accept_hist"):
+        assert key in flat, key

@@ -91,7 +91,17 @@ HOT_FN_RE = re.compile(
     # device sync there stalls every replica's step clock
     r"|heartbeat_tick|vote_dead|poll_results|request|handoff"
     r"|_transport_tick|_autoscale_tick|_scale_up|_scale_down"
-    r"|_record_scale)$")
+    r"|_record_scale"
+    # prefix cache + speculative decode (ISSUE 17): the radix walk
+    # (lookup/attach/insert), refcount bookkeeping and LRU reclaim run
+    # at ADMISSION for every request, and the draft/verify tick runs
+    # once per decode dispatch over every lane.  The COW split is
+    # allowed exactly ONE device dispatch (the jitted _cow_copy_rows
+    # program inside _cow_copy) and the verify tick ONE batched fetch —
+    # a sync per tree node, per draft token or per lane would serialize
+    # admission and decode against the host
+    r"|prefix_\w+|_cow_copy\w*|_reclaim_\w+|warm_cow|cached_blocks"
+    r"|_touch|_rank_slot|_prefix_probe|_draft_\w+|_spec_\w+)$")
 # benchmark drivers: every loop is (or brackets) a timed region — a sync
 # per iteration pollutes the measured step time with transfer latency
 BENCH_FILES = {"bench.py", "tools/pipe_bench.py", "tools/serve_bench.py"}

@@ -81,6 +81,15 @@ CONFIGS = {
         pool=dict(_POOL, kv_dtype="bfloat16", quantized=False)),
     "serving/gpt2-350m-ish/decode-b8/pool-int8": dict(
         pool=dict(_POOL, kv_dtype="bfloat16", quantized=True)),
+    # the same logical demand under prefix sharing (ISSUE 17): a
+    # 16-block system prompt mapped read-only by 8 concurrent requests
+    # is stored ONCE — 513 logical blocks need only 401 physical
+    "serving/gpt2-350m-ish/decode-b8/pool-bf16-prefix-shared": dict(
+        pool=dict(_POOL, kv_dtype="bfloat16", quantized=False,
+                  shared_blocks=16, shared_refs=8)),
+    "serving/gpt2-350m-ish/decode-b8/pool-int8-prefix-shared": dict(
+        pool=dict(_POOL, kv_dtype="bfloat16", quantized=True,
+                  shared_blocks=16, shared_refs=8)),
     # zb-h1 bounded stashing: worst-stage peak stash bytes (see _STASH)
     "gpt2-350m-ish/pipe4/gas8/zb-stash-peak": dict(stash=_STASH),
 }
@@ -112,7 +121,9 @@ def compute_peaks():
             bytes_ = ma.kv_pool_bytes(
                 pool["n_layer"], pool["num_blocks"], pool["n_head"],
                 pool["block_size"], pool["head_dim"],
-                kv_dtype=pool["kv_dtype"], quantized=pool["quantized"])
+                kv_dtype=pool["kv_dtype"], quantized=pool["quantized"],
+                shared_blocks=pool.get("shared_blocks", 0),
+                shared_refs=pool.get("shared_refs", 1))
             out[name] = {"peak_bytes": bytes_, "persistent_bytes": bytes_,
                          "transient_bytes": 0}
             continue

@@ -126,6 +126,12 @@ def trend_rows(rounds):
                 "peak_hbm_bytes": payload.get("peak_hbm_bytes"),
                 "hbm_delta_vs_analytic":
                     payload.get("hbm_delta_vs_analytic"),
+                # serving cost-per-token (ISSUE 17): rounds without a
+                # serving leg lack the keys and show as honest gaps —
+                # a None hit rate must never read as "cache missed
+                # everything", nor a None tokens-per-verify as 1.0
+                "prefix_hit_rate": payload.get("prefix_hit_rate"),
+                "tokens_per_verify": payload.get("tokens_per_verify"),
                 "trace": tel.get("trace"),
                 "metrics_jsonl": tel.get("metrics_jsonl"),
             })
@@ -175,7 +181,8 @@ def trend_payload(pattern=DEFAULT_GLOB, root=".",
                      "tokens_per_sec", "goodput_samples_per_wall_step",
                      "mttr_steps_mean", "detection_latency_steps",
                      "corruption_recovered", "peak_hbm_bytes",
-                     "hbm_delta_vs_analytic")} for r in rows],
+                     "hbm_delta_vs_analytic", "prefix_hit_rate",
+                     "tokens_per_verify")} for r in rows],
         "dead_rounds": [r["round"] for r in rows if not r["ok"]],
         "regression": check_regression(rows, threshold),
     }
@@ -210,7 +217,7 @@ def main(argv=None):
     else:
         print(f"{'round':>5} {'ok':>3} {'value':>10} {'mfu':>7} "
               f"{'step_ms':>9} {'tok/s':>12} {'det.lat':>8} {'recov':>6} "
-              f"{'hbm_GiB':>8}  metric")
+              f"{'hbm_GiB':>8} {'pfx_hit':>8} {'tok/ver':>8}  metric")
         for r in rows:
             hbm = r.get("peak_hbm_bytes")
             print(f"{r['round']:>5} {'y' if r['ok'] else 'n':>3} "
@@ -219,7 +226,9 @@ def main(argv=None):
                   f"{_fmt(r.get('tokens_per_sec'), 0):>12} "
                   f"{_fmt(r.get('detection_latency_steps'), 0):>8} "
                   f"{_fmt(r.get('corruption_recovered')):>6} "
-                  f"{_fmt(hbm / 2**30 if hbm else None, 2):>8}  "
+                  f"{_fmt(hbm / 2**30 if hbm else None, 2):>8} "
+                  f"{_fmt(r.get('prefix_hit_rate'), 3):>8} "
+                  f"{_fmt(r.get('tokens_per_verify'), 3):>8}  "
                   f"{(r.get('metric') or '-')[:60]}")
         if verdict["baseline"]:
             word = "REGRESSED" if verdict["regressed"] else "ok"

@@ -18,8 +18,13 @@ Traffic modes (``--traffic``):
   goodput >= 0.75x a steady-state baseline, while DISARMED shows the
   congestion collapse (TTFT blow-up + wasted decoded tokens).
 - ``shared-prefix`` — every prompt shares a long system-prompt prefix
-  (ROADMAP item 3's workload; today it prices the duplicated prefill
-  that a future prefix cache removes).
+  (ROADMAP item 3's workload), served twice: radix prefix cache
+  DISARMED vs ARMED.  Gate: >= 2x fewer prefill tokens computed with
+  the cache (the r02 mode's 744 duplicated tokens mostly eliminated).
+- ``spec-decode`` — the steady mixed workload served twice: plain
+  one-token decode vs self-speculative draft-k/verify-once.  Greedy
+  acceptance is bit-honest, so token totals must match; the win is
+  fewer decode dispatches (tokens-per-verify > 1).
 - ``replica-failure`` — the fleet A/B (``--fleet K`` replicas behind
   the SLO-aware router, ISSUE 11): the SAME traffic twice on a step
   clock, once undisturbed and once with chaos hard-killing 1 of K
@@ -138,7 +143,8 @@ def _arrival_schedule(n, *, every=1, burst=1, gap=0):
 
 def run_mode(model, params, workload, *, policy, slots, chunk,
              arrivals, reliability=None, clock=None, step_clock=False,
-             deadline=None):
+             deadline=None, block=16, prefix_cache=False,
+             speculative=None):
     import jax
 
     from deepspeed_tpu.serving.engine import InferenceEngine
@@ -149,8 +155,10 @@ def run_mode(model, params, workload, *, policy, slots, chunk,
     if clock is not None:
         kw["clock"] = clock
     eng = InferenceEngine(model, params, max_slots=slots,
-                          kv_block_size=16, prefill_chunk=chunk,
-                          max_blocks_per_seq=8, policy=policy, **kw)
+                          kv_block_size=block, prefill_chunk=chunk,
+                          max_blocks_per_seq=8, policy=policy,
+                          prefix_cache=prefix_cache,
+                          speculative=speculative, **kw)
     eng.warmup()                       # compiles outside the timed region
     t0 = time.perf_counter()
     pending = [(arrivals[i], w) for i, w in enumerate(workload)]
@@ -198,6 +206,16 @@ def run_mode(model, params, workload, *, policy, slots, chunk,
         "predicted_ttft_mean":
             _r(rel["admission"]["predicted_ttft_s"]["mean"]),
         "kv_occupancy_mean": _r(rep["kv_pool"]["occupancy_mean"]),
+        # ISSUE 17 cost-per-token accounting: what prefill actually ran
+        # (vs what the cache served) and what each verify delivered
+        "prefill_tokens_computed":
+            rep["prefix_cache"]["prefill_tokens_computed"],
+        "prefix_hit_rate": _r(rep["prefix_cache"]["hit_rate"]),
+        "prefix_avoided_tokens":
+            rep["prefix_cache"]["avoided_prefill_tokens"],
+        "tokens_per_verify":
+            _r(rep["speculative"]["tokens_per_verify"]),
+        "spec_accept_hist": rep["speculative"]["accept_len_hist"],
     }
 
 
@@ -319,19 +337,80 @@ def run_overload(model, params, args, out):
 
 
 def run_shared_prefix(model, params, args, out):
+    """Prefix-cache A/B on the exact r02 traffic shape: the SAME
+    system-prompt workload with the radix cache DISARMED vs ARMED.
+    Block size 8 so the 24-token prefix tiles 3 full shareable blocks;
+    the gate (>= 2x fewer prefill tokens computed) mirrors tier-1
+    ``test_prefix_cache_prefill_ratio_guard``."""
     workload = make_shared_prefix_workload(args.requests, args.vocab,
                                            args.seed)
-    r = run_mode(model, params, workload, policy="continuous",
-                 slots=args.slots, chunk=args.chunk,
-                 arrivals=_arrival_schedule(len(workload), every=1))
-    out["shared_prefix"] = r
+    common = dict(policy="continuous", slots=args.slots,
+                  chunk=args.chunk, block=8,
+                  arrivals=_arrival_schedule(len(workload), every=1))
+    nocache = run_mode(model, params, workload, **common)
+    cached = run_mode(model, params, workload, prefix_cache=True,
+                      **common)
+    out["no_cache"], out["prefix_cache"] = nocache, cached
     prefix_tokens = 24 * (args.requests - 1)
     out["duplicated_prefill_tokens"] = prefix_tokens
-    _print_row("shared-prefix", r)
-    print(f"duplicated prefix prefill: {prefix_tokens} tokens "
-          f"(24-token system prompt x {args.requests - 1} re-prefills — "
-          f"the prefix-cache target, ROADMAP item 3)")
-    return 0
+    _print_row("no-cache", nocache)
+    _print_row("prefix-cache", cached)
+    ratio = (nocache["prefill_tokens_computed"]
+             / cached["prefill_tokens_computed"]) \
+        if cached["prefill_tokens_computed"] else None
+    out["prefill_computed_ratio"] = _r(ratio, 3)
+    ok = (ratio is not None and ratio >= 2.0
+          and cached["completed"] == cached["submitted"]
+          and cached["tokens"] == nocache["tokens"])
+    out["guard_ok"] = ok
+    print(f"shared-prefix guard: {'OK' if ok else 'FAIL'} — prefill "
+          f"tokens computed {nocache['prefill_tokens_computed']} -> "
+          f"{cached['prefill_tokens_computed']} ({ratio:.2f}x fewer); "
+          f"hit rate {cached['prefix_hit_rate']}, "
+          f"{cached['prefix_avoided_tokens']} tokens served from cache "
+          f"(vs {prefix_tokens} duplicated prefix tokens priced by r02; "
+          f"COW partial-tail sharing can exceed it)")
+    return 0 if ok else 1
+
+
+def run_spec_decode(model, params, args, out):
+    """Speculative-decode A/B on the steady mixed workload: the SAME
+    continuous-batching engine with plain one-token decode vs the
+    draft-``k``/verify-once jit.  Greedy acceptance is bit-honest, so
+    generated-token totals must MATCH; the win is fewer decode
+    dispatches (each verify step can deliver up to k+1 tokens)."""
+    workload = make_workload(args.requests, args.vocab, args.seed)
+    common = dict(policy="continuous", slots=args.slots,
+                  chunk=args.chunk,
+                  arrivals=_arrival_schedule(len(workload),
+                                             every=args.arrival_every))
+    base = run_mode(model, params, workload, **common)
+    spec = run_mode(model, params, workload,
+                    speculative=args.draft_len, **common)
+    out["baseline"], out["speculative"] = base, spec
+    out["draft_len"] = args.draft_len
+    _print_row("plain decode", base)
+    _print_row(f"spec k={args.draft_len}", spec)
+    step_ratio = (base["decode_steps"] / spec["decode_steps"]) \
+        if spec["decode_steps"] else None
+    out["decode_step_ratio"] = _r(step_ratio, 3)
+    ok = (spec["completed"] == spec["submitted"]
+          and spec["tokens"] == base["tokens"]
+          and spec["tokens_per_verify"] is not None
+          and spec["tokens_per_verify"] >= 1.0
+          and spec["decode_steps"] <= base["decode_steps"])
+    out["guard_ok"] = ok
+    print(f"spec-decode guard: {'OK' if ok else 'FAIL'} — "
+          f"{base['decode_steps']} -> {spec['decode_steps']} decode "
+          f"dispatches ({_fmt_ratio(step_ratio)} fewer) at "
+          f"{spec['tokens_per_verify']} tokens/verify, accept-length "
+          f"hist {spec['spec_accept_hist']}, token totals "
+          f"{'MATCH' if spec['tokens'] == base['tokens'] else 'DIFFER'}")
+    return 0 if ok else 1
+
+
+def _fmt_ratio(x):
+    return "-" if x is None else f"{x:.2f}x"
 
 
 def run_replica_failure(model, params, args, out):
@@ -557,8 +636,8 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--traffic", default="steady",
                    choices=["steady", "bursty", "overload",
-                            "shared-prefix", "replica-failure",
-                            "diurnal"])
+                            "shared-prefix", "spec-decode",
+                            "replica-failure", "diurnal"])
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--requests", type=int, default=32)
     p.add_argument("--chunk", type=int, default=16)
@@ -585,6 +664,8 @@ def main(argv=None):
     p.add_argument("--kill-step", type=int, default=12,
                    help="engine step at which chaos hard-kills replica "
                         "1 (replica-failure)")
+    p.add_argument("--draft-len", type=int, default=3,
+                   help="speculative draft length k (spec-decode)")
     p.add_argument("--json", default=None)
     args = p.parse_args(argv)
 
@@ -595,6 +676,7 @@ def main(argv=None):
     rc = {"steady": run_steady, "bursty": run_bursty,
           "overload": run_overload,
           "shared-prefix": run_shared_prefix,
+          "spec-decode": run_spec_decode,
           "replica-failure": run_replica_failure,
           "diurnal": run_diurnal}[args.traffic](
         model, params, args, out)

@@ -60,7 +60,7 @@ REFERENCE_TFLOPS_PER_CHIP = 64.0
 # spec keys that define a bench configuration (the phase-cache identity)
 _SPEC_KEYS = ("model", "batch", "seq", "steps", "warmup", "scan_layers",
               "remat", "remat_policy", "allow_cpu", "loss_chunk", "offload",
-              "onebit", "sparse", "zero_stage", "chaos")
+              "onebit", "sparse", "zero_stage", "chaos", "optimizer")
 
 
 def _cfg_hash(spec, base=None):
@@ -234,6 +234,9 @@ def _run_one(args, ctx) -> int:
     if args.onebit:
         return run_onebit_worker(args, jax, jnp, np, device_kind, platform,
                                  n_dev)
+    if getattr(args, "optimizer", "") == "zeroone":
+        return run_zeroone_worker(args, jax, jnp, np, device_kind, platform,
+                                  n_dev)
     if getattr(args, "chaos", ""):
         return run_chaos_worker(args, jax, jnp, np, device_kind, platform,
                                 n_dev)
@@ -877,6 +880,101 @@ def run_onebit_worker(args, jax, jnp, np, device_kind, platform, n_dev):
     return 0
 
 
+def run_zeroone_worker(args, jax, jnp, np, device_kind, platform, n_dev):
+    """PR-18 rung (``--optimizer zeroone``): 0/1 Adam — variance freeze +
+    1-bit sign wire + k-step local rounds — vs the fused dense-Adam
+    baseline, A/B in ONE attempt.  Publishes the post-freeze step-time
+    ratio plus the ANALYTIC optimizer wire (amortized bytes/step and the
+    vs-qgZ ratio straight from engine.comm_volume_report) — the byte win
+    is the transferable claim; on one chip the collective is local, so
+    the armed flag and n_devices qualify the number instead of implying
+    a wire win the rung didn't measure."""
+    import time as _t
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Model, gpt2_config
+
+    model_name = args.model if args.model.startswith("gpt2") else "gpt2-125m"
+    freeze, local_k = 4, 2
+
+    def measure(zeroone):
+        cfg = gpt2_config(model_name, n_positions=args.seq,
+                          dtype=jnp.bfloat16, remat=bool(args.remat),
+                          remat_policy=args.remat_policy,
+                          scan_layers=bool(args.scan_layers),
+                          loss_chunk_tokens=args.loss_chunk)
+        model = GPT2Model(cfg)
+        opt = ({"type": "ZeroOneAdam",
+                "params": {"lr": 1e-4, "var_freeze_step": freeze,
+                           "local_steps": local_k}} if zeroone else
+               {"type": "Adam", "params": {"lr": 1e-4}})
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config_params={
+                "train_batch_size": args.batch * n_dev,
+                "train_micro_batch_size_per_gpu": args.batch,
+                "gradient_accumulation_steps": 1,
+                "optimizer": opt,
+                "bf16": {"enabled": True},
+                "mesh": {"data": n_dev, "model": 1, "pipe": 1},
+                "steps_per_print": 10 ** 9})
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size,
+                           (1, args.batch * n_dev, args.seq))
+        batch = {"input_ids": ids, "labels": ids.copy()}
+        loss = engine.train_batch(batch=batch)      # compile warmup program
+        float(jax.device_get(loss))
+        if zeroone:
+            # cross the freeze plus one full local/sync round so every
+            # cadence program is compiled before the timer starts
+            while engine.global_steps < freeze + 2 * local_k:
+                loss = engine.train_batch(batch=batch)
+            float(jax.device_get(loss))
+        for _ in range(max(0, args.warmup - 1)):
+            loss = engine.train_batch(batch=batch)
+        float(jax.device_get(loss))   # drain warmup before the timer
+        t0 = _t.time()
+        for _ in range(args.steps):
+            loss = engine.train_batch(batch=batch)
+        float(jax.device_get(loss))
+        ms = (_t.time() - t0) / args.steps * 1000.0
+        # extract the scalars and DROP the engine: holding it through the
+        # other arm's measurement would double params+opt-state HBM
+        armed = bool(engine._zeroone_wire()) if zeroone else None
+        rep = engine.comm_volume_report(refresh=True) if zeroone else None
+        return ms, armed, rep
+
+    z_ms, armed, rep = measure(True)
+    _phase(f"zeroone_done:{z_ms:.1f}")
+    adam_ms, _, _ = measure(False)
+    _phase(f"zeroone_adam_done:{adam_ms:.1f}")
+    ow = (rep or {}).get("optimizer_wire") or {}
+    base = ow.get("baseline", {})
+    print(json.dumps({
+        "metric": f"0/1 Adam post-freeze step time vs fused Adam "
+                  f"({model_name} seq{args.seq}, "
+                  f"{'wire path' if n_dev > 1 else 'single chip'}, "
+                  f"{n_dev} chip)",
+        "value": round(adam_ms / z_ms, 3),
+        "unit": "x step-time vs dense Adam",
+        "vs_baseline": round(adam_ms / z_ms, 3),
+        "zeroone_ms": round(z_ms, 1),
+        "adam_ms": round(adam_ms, 1),
+        "zeroone_armed": armed,
+        "var_freeze_step": freeze,
+        "local_steps_k": ow.get("config", {}).get("local_steps_k", local_k),
+        "optimizer_wire_bytes_per_step":
+            ow.get("amortized_grad_exchange_bytes_per_step"),
+        "optimizer_wire_sync_round_bytes": ow.get("sync_round_bytes"),
+        "optimizer_wire_vs_qgz": ow.get("vs_qgz_ratio"),
+        "optimizer_wire_vs_fp32": ow.get("vs_fp32_ratio"),
+        "qgz_int8_wire_bytes_per_step":
+            base.get("qgz_int8_wire_bytes_per_step"),
+        "device_kind": device_kind, "platform": platform,
+        "n_devices": n_dev, "batch_per_chip": args.batch,
+    }), flush=True)
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # parent driver: attempt ladder + retries + structured failure
 # ---------------------------------------------------------------------------
@@ -1101,6 +1199,11 @@ def run_parent(args) -> int:
         # in the perf trajectory, phase-cached under its own config hash
         {"model": "gpt2-350m", "batch": 16, "seq": 1024, "steps": 10,
          "zero_stage": 3, "timeout": max(400, args.budget_s // 3)},
+        # PR-18 zeroone rung: 0/1 Adam vs fused dense Adam, A/B in one
+        # attempt (run_zeroone_worker) — records the optimizer-wire win
+        # in the perf trajectory, phase-cached under its own config hash
+        {"model": "gpt2-350m", "batch": 16, "seq": 1024, "steps": 10,
+         "optimizer": "zeroone", "timeout": max(400, args.budget_s // 3)},
         {"model": "gpt2-125m", "batch": 8, "seq": 512, "steps": 10,
          "timeout": max(300, args.budget_s // 3)},
         {"model": "gpt2-125m", "batch": 4, "seq": 256, "steps": 5,
@@ -1416,6 +1519,11 @@ def main():
     p.add_argument("--onebit", type=int, default=0,
                    help="BASELINE config 5: OneBitAdam wire path, warmup vs "
                         "post-freeze step time")
+    p.add_argument("--optimizer", default="",
+                   choices=["", "zeroone"],
+                   help="'zeroone' runs the 0/1 Adam vs fused-Adam A/B "
+                        "(run_zeroone_worker): post-freeze step-time "
+                        "ratio + analytic optimizer wire bytes/step")
     p.add_argument("--sparse", type=int, default=0,
                    help="BERT models: block-sparse attention "
                         "(FixedSparsityConfig local4+global1, block 64)")

@@ -1,0 +1,230 @@
+"""0/1 Adam — variance-frozen, 1-bit-compressed, locally-skipped Adam.
+
+Reference behavior (arxiv 2202.06009; deepspeed/runtime/fp16/onebit/
+zoadam.py): 0/1 Adam extends 1-bit Adam with two levers —
+- **variance freeze**: after ``var_freeze_step`` optimizer steps the second
+  moment v stops updating (1-bit Adam's freeze), and
+- **adaptive local steps**: synced rounds happen only every k-th step;
+  between syncs workers take LOCAL steps with no communication at all, and
+  k grows on a schedule (``local_step_scaler`` / ``local_step_clipper``),
+  amortizing even the 1-bit wire over k steps.
+
+SPMD-honest formulation: the paper lets worker replicas diverge between
+syncs.  Under the engine's shard_map step (replicated params, out_specs
+P()) silently-divergent params would break the replication invariant the
+checkpoint/eval paths rely on, so local rounds here ACCUMULATE the device-
+local gradient into a per-device buffer instead of applying it; the sync
+round averages the accumulated k-step gradient through the 1-bit wire
+(:func:`~deepspeed_tpu.runtime.custom_collectives.quantized_all_reduce`)
+and applies one lr*k-compensated update.  Per-device divergence is
+confined to the error-feedback residuals and the local accumulator —
+exactly the state that already carries a leading per-device axis.  The
+parity caveat (forward does not see local progress between syncs) is
+documented in docs/tutorials/quantized_comms.md.
+
+Phase selection is a PURE FUNCTION of the completed-optimizer-step count
+(:func:`zeroone_cadence`), so an elastic resume re-derives the phase from
+restored counters alone.
+"""
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.custom_collectives import (
+    quantized_all_reduce, quantized_error_feedback)
+
+
+def zeroone_cadence(completed_steps, var_freeze_step, local_steps=1,
+                    local_step_scaler=0, local_step_clipper=16):
+    """(phase, k_round) for the optimizer step about to be taken after
+    ``completed_steps`` finished ones.  Pure host-side function of the
+    step index — the engine (and an elastic resume) re-derive the phase
+    from counters, never from traced state.
+
+    - ``completed_steps < var_freeze_step`` -> ``('warmup', 1)``: exact
+      (bias-correction-free) Adam, v still updating.
+    - after the freeze, steps are partitioned into rounds of length k:
+      ``k - 1`` 'local' steps then one 'sync' step.  k starts at
+      ``local_steps`` and doubles every ``local_step_scaler`` ROUNDS
+      (0 = fixed k), capped at ``local_step_clipper`` (0 = uncapped) —
+      the deterministic variant of the paper's adaptive policy.
+
+    ``k_round`` is the length of the current round (1 during warmup):
+    the sync step scales lr by it and divides the accumulated gradient.
+    """
+    if completed_steps < var_freeze_step:
+        return "warmup", 1
+    j = completed_steps - var_freeze_step
+    start, r = 0, 0
+    while True:
+        k = max(1, int(local_steps))
+        if local_step_scaler:
+            k = k * (2 ** (r // int(local_step_scaler)))
+        if local_step_clipper:
+            k = min(k, max(1, int(local_step_clipper)))
+        if j < start + k:
+            return ("sync" if j == start + k - 1 else "local"), k
+        start += k
+        r += 1
+
+
+class ZeroOneAdamState(NamedTuple):
+    step: object           # i32 — completed optimizer steps (every phase)
+    m: object              # momentum pytree, fp32, replicated
+    v: object              # variance pytree, fp32 (frozen after warmup)
+    worker_error: object   # per-device EF residual pytree (worker stage)
+    server_error: object   # per-device EF residual pytree (server chunks)
+    local_accum: object    # per-device gradient accumulator (local rounds)
+
+
+class ZeroOneAdam:
+    name = "zerooneadam"
+
+    def __init__(self, lr=1e-3, var_freeze_step=100000, betas=(0.9, 0.999),
+                 eps=1e-8, weight_decay=0.0, max_grad_norm=0.0,
+                 local_steps=1, local_step_scaler=0, local_step_clipper=16,
+                 bits=1, quantization_block_size=None, intra_size=0,
+                 cuda_aware=False, comm_backend_name="xla", mesh=None,
+                 axis_name=None, axis_size=1):
+        self.lr = lr
+        self.var_freeze_step = var_freeze_step
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.local_steps = local_steps
+        self.local_step_scaler = local_step_scaler
+        self.local_step_clipper = local_step_clipper
+        self.bits = bits
+        self.quantization_block_size = quantization_block_size
+        self.intra_size = intra_size
+        self.comm_backend_name = comm_backend_name
+        self.mesh = mesh
+        # when set, sync rounds run the true packed-wire collective inside
+        # shard_map with this axis bound; per-device state (residuals +
+        # accumulator) carries a leading (axis_size,) dim sharded over it
+        self.axis_name = axis_name
+        self.axis_size = axis_size
+
+    def cadence(self, completed_steps):
+        return zeroone_cadence(completed_steps, self.var_freeze_step,
+                               self.local_steps, self.local_step_scaler,
+                               self.local_step_clipper)
+
+    def _chunk(self, n):
+        """Per-device server-residual length for an n-element leaf: the
+        leaf is padded to a multiple of the axis size before the wire."""
+        w = max(1, self.axis_size if self.axis_name is not None else 1)
+        return (n + (-n) % w) // w
+
+    def init_state(self, master_params) -> ZeroOneAdamState:
+        zeros = lambda: jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), master_params)
+        if self.axis_name is not None:
+            dev = lambda: jax.tree_util.tree_map(
+                lambda p: jnp.zeros((self.axis_size,) + p.shape,
+                                    jnp.float32), master_params)
+            serr = lambda: jax.tree_util.tree_map(
+                lambda p: jnp.zeros((self.axis_size, self._chunk(p.size)),
+                                    jnp.float32), master_params)
+        else:
+            dev = zeros
+            serr = zeros
+        return ZeroOneAdamState(step=jnp.int32(0), m=zeros(), v=zeros(),
+                                worker_error=dev(), server_error=serr(),
+                                local_accum=dev())
+
+    def update(self, grads, state: ZeroOneAdamState, master_params,
+               lr=None, scale=1.0, phase="warmup", k_round=1):
+        """One optimizer step of the statically-selected ``phase``
+        ('warmup' | 'sync' | 'local', from :func:`zeroone_cadence` for
+        ``state.step``).  The engine compiles one program per phase, so
+        local-round HLO provably contains ZERO cross-device collectives
+        and sync-round HLO only the packed sub-byte wire.  ``k_round``
+        (traced scalar ok) is the current round length: the sync step
+        divides the accumulated gradient and scales lr by it."""
+        assert phase in ("warmup", "sync", "local"), phase
+        lr = self.lr if lr is None else lr
+        step = state.step + 1
+        b1, b2 = self.beta1, self.beta2
+        kf = jnp.float32(k_round)
+
+        def leaf(g, m, v, we, se, acc, p):
+            g = g.astype(jnp.float32) / scale
+
+            if phase == "local":
+                # accumulate only: params, m, v untouched — no collective
+                return p, m, v, we, se, acc + g
+
+            if phase == "warmup":
+                g_sync = jax.lax.pmean(g, self.axis_name) \
+                    if self.axis_name is not None else g
+                m_out = b1 * m + (1.0 - b1) * g_sync
+                v_out = b2 * v + (1.0 - b2) * jnp.square(g_sync)
+                acc_out, we_out, se_out = acc, we, se
+                lr_eff = lr
+            else:  # sync: compressed round gradient, frozen variance
+                g_round = (acc + g) / kf
+                flat = g_round.reshape(-1)
+                fwe = we.reshape(-1)
+                fse = se.reshape(-1)
+                if self.axis_name is not None:
+                    pad = (-flat.size) % self.axis_size
+                    g_avg, we_new, se_new = quantized_all_reduce(
+                        jnp.pad(flat, (0, pad)), self.axis_name,
+                        bits=self.bits,
+                        block_size=self.quantization_block_size,
+                        intra_size=self.intra_size,
+                        worker_error=jnp.pad(fwe, (0, pad)),
+                        server_error=fse)
+                    g_avg = g_avg[:flat.size]
+                    we_new = we_new[:flat.size]
+                else:
+                    g_avg, we_new, se_new = quantized_error_feedback(
+                        flat, fwe, fse, bits=self.bits,
+                        block_size=self.quantization_block_size)
+                m_out = b1 * m + (1.0 - b1) * g_avg.reshape(m.shape)
+                v_out = v
+                we_out = we_new.reshape(we.shape)
+                se_out = se_new.reshape(se.shape)
+                acc_out = jnp.zeros_like(acc)
+                # one update stands in for the k steps of its round
+                lr_eff = lr * kf
+
+            update = m_out / (jnp.sqrt(v_out) + self.eps)
+            if self.weight_decay > 0.0:
+                update = update + self.weight_decay * p
+            return p - lr_eff * update, m_out, v_out, we_out, se_out, acc_out
+
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat = lambda t: jax.tree_util.tree_leaves(t)
+        outs = [leaf(g, m, v, we, se, acc, p) for g, m, v, we, se, acc, p in
+                zip(flat_g, flat(state.m), flat(state.v),
+                    flat(state.worker_error), flat(state.server_error),
+                    flat(state.local_accum), flat(master_params))]
+        unf = treedef.unflatten
+        new_p, new_m, new_v, new_we, new_se, new_acc = \
+            (unf(list(t)) for t in zip(*outs))
+        return new_p, ZeroOneAdamState(step=step, m=new_m, v=new_v,
+                                       worker_error=new_we,
+                                       server_error=new_se,
+                                       local_accum=new_acc)
+
+    def state_spec(self, param_specs):
+        from jax.sharding import PartitionSpec as P
+
+        err_specs = param_specs
+        chunk_specs = param_specs
+        if self.axis_name is not None:
+            # per-device state: leading dim sharded over the axis; server
+            # residuals are 2-D (axis_size, chunk) regardless of leaf rank
+            err_specs = jax.tree_util.tree_map(
+                lambda s: P(self.axis_name, *s), param_specs,
+                is_leaf=lambda x: isinstance(x, P))
+            chunk_specs = jax.tree_util.tree_map(
+                lambda s: P(self.axis_name, None), param_specs,
+                is_leaf=lambda x: isinstance(x, P))
+        return ZeroOneAdamState(step=None, m=param_specs, v=param_specs,
+                                worker_error=err_specs,
+                                server_error=chunk_specs,
+                                local_accum=err_specs)

@@ -48,11 +48,14 @@ ADAM_OPTIMIZER = "adam"
 ADAMW_OPTIMIZER = "adamw"
 LAMB_OPTIMIZER = "lamb"
 ONEBIT_ADAM_OPTIMIZER = "onebitadam"
+# 0/1 Adam (arxiv 2202.06009): variance freeze + 1-bit wire + local steps
+ZEROONE_ADAM_OPTIMIZER = "zerooneadam"
 # extension: sgd and adafactor are also built-in on the TPU build
 SGD_OPTIMIZER = "sgd"
 ADAFACTOR_OPTIMIZER = "adafactor"
 DEEPSPEED_OPTIMIZERS = [ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER,
-                        ONEBIT_ADAM_OPTIMIZER, SGD_OPTIMIZER, ADAFACTOR_OPTIMIZER]
+                        ONEBIT_ADAM_OPTIMIZER, ZEROONE_ADAM_OPTIMIZER,
+                        SGD_OPTIMIZER, ADAFACTOR_OPTIMIZER]
 
 #############################################
 # ZeRO optimization (top-level key lives in zero/constants.py)

@@ -257,6 +257,256 @@ def quantized_all_gather(x, mesh, *, dim=0, axis_name="data",
     return gather(x)
 
 
+def quantized_all_reduce(x, axis_name, *, bits=1, block_size=None,
+                         intra_size=0, worker_error=None,
+                         server_error=None):
+    """EQuARX-style quantized MEAN-all-reduce of per-device ``x`` over
+    ``axis_name`` (arxiv 2506.17615): quantize -> reduce-scatter ->
+    requantize -> all-gather, entirely in the quantized wire format.
+
+    Must run inside shard_map with ``axis_name`` manual — the same
+    GSPMD gotcha as :func:`quantized_all_gather`: only manual-mode
+    collectives pin the sub-byte payload dtype in the compiled HLO.
+    ``x`` is the device-local flat fp32 tensor; ``x.size`` must divide
+    the axis size (1-bit rows pad to the 8-sign byte quantum
+    internally, per ``quantization.sign_pack_layout``).
+
+    ``bits`` selects the wire: 8 = blockwise int8 (the qgZ code), 1 =
+    packed sign bits + per-block mean-magnitude fp32 scales (the 0/1
+    Adam code, arxiv 2202.06009).  Error feedback: ``worker_error``
+    (x-shaped) is added before the first quantize, ``server_error``
+    (chunk-shaped, ``x.size // w``) at the reduced mean before the
+    requantize; both residuals are returned updated.  The hierarchical
+    hop-2 requantize and the all-gather hops are stateless — their
+    quantization error is not compensated (int8 keeps it negligible;
+    the 1-bit engine path absorbs it in the next round's residual).
+
+    Hierarchical scheme (1 < intra_size < w, intra_size | w): the qgZ
+    two-hop ``axis_index_groups`` composition on both phases — the
+    reduce-scatter runs intra-group then inter-group on requantized
+    partial sums, the all-gather runs inter-group then intra-group on
+    the same quantized payload (no re-encode: gathers move code, not
+    values), so cross-group traffic drops to 1/intra_size.
+
+    Overflow safety: non-finite inputs give non-finite block scales in
+    both formats, so the averaged output comes back non-finite and the
+    fp16 loss-scale overflow check still trips through the wire.
+
+    Returns ``(mean, new_worker_error, new_server_error)``.
+    """
+    from deepspeed_tpu.runtime.quantization import (DEFAULT_BLOCK_SIZE,
+                                                    dequantize_rows,
+                                                    dequantize_signs_rows,
+                                                    quantize_rows,
+                                                    quantize_signs_rows)
+
+    if block_size is None:
+        block_size = DEFAULT_BLOCK_SIZE
+    assert bits in (1, 8), f"quantized_all_reduce: bits must be 1 or 8, got {bits}"
+    if bits == 1:
+        def quant(rows):
+            return quantize_signs_rows(rows, block_size)
+
+        def dequant(q, s, n):
+            return dequantize_signs_rows(q, s, n, block_size=block_size)
+    else:
+        def quant(rows):
+            return quantize_rows(rows, block_size)
+
+        def dequant(q, s, n):
+            return dequantize_rows(q, s, n)
+
+    w = lax.axis_size(axis_name)
+    n = x.size
+    xf = x.astype(jnp.float32).reshape(-1)
+    we = jnp.zeros_like(xf) if worker_error is None else \
+        worker_error.astype(jnp.float32).reshape(-1)
+    buf = xf + we
+
+    if w == 1:
+        # single-device twin: both quantization stages run locally so the
+        # numerics (and residual state) match the distributed scheme
+        se = jnp.zeros_like(xf) if server_error is None else \
+            server_error.astype(jnp.float32).reshape(-1)
+        return quantized_error_feedback(xf, we, se, bits=bits,
+                                        block_size=block_size)
+
+    assert n % w == 0, \
+        f"quantized_all_reduce needs size % {w} == 0, got {n}"
+    nloc = n // w
+    rows = buf.reshape(w, nloc)
+
+    k = int(intra_size or 0)
+    if not (1 < k < w and w % k == 0):
+        k = 0
+
+    # --- reduce-scatter phase: after it rank r holds sum chunk r ---------
+    if not k:
+        q, s = quant(rows)
+        new_we = buf - dequant(q, s, nloc).reshape(-1)
+        qr = lax.all_to_all(q, axis_name, 0, 0, tiled=False)
+        sr = lax.all_to_all(s, axis_name, 0, 0, tiled=False)
+        total = dequant(qr, sr, nloc).sum(0)
+    else:
+        m_g = w // k
+        groups_intra = [[o * k + i for i in range(k)] for o in range(m_g)]
+        groups_inter = [[o * k + i for o in range(m_g)] for i in range(k)]
+        # hop 1 (intra): regroup rows so the k pieces sent within my group
+        # are keyed by destination INTRA index (quantized_reduce_scatter)
+        x1 = rows.reshape(m_g, k, nloc).transpose(1, 0, 2).reshape(k, -1)
+        q1, s1 = quant(x1)
+        new_we = (x1 - dequant(q1, s1, m_g * nloc)).reshape(
+            k, m_g, nloc).transpose(1, 0, 2).reshape(-1)
+        qr1 = lax.all_to_all(q1, axis_name, 0, 0, tiled=False,
+                             axis_index_groups=groups_intra)
+        sr1 = lax.all_to_all(s1, axis_name, 0, 0, tiled=False,
+                             axis_index_groups=groups_intra)
+        partial = dequant(qr1, sr1, m_g * nloc).sum(0)
+        # hop 2 (inter): requantized partial sums, 1/k the flat traffic
+        q2, s2 = quant(partial.reshape(m_g, nloc))
+        qr2 = lax.all_to_all(q2, axis_name, 0, 0, tiled=False,
+                             axis_index_groups=groups_inter)
+        sr2 = lax.all_to_all(s2, axis_name, 0, 0, tiled=False,
+                             axis_index_groups=groups_inter)
+        total = dequant(qr2, sr2, nloc).sum(0)
+
+    # --- requantize phase: server residual at the mean -------------------
+    se = jnp.zeros((nloc,), jnp.float32) if server_error is None else \
+        server_error.astype(jnp.float32).reshape(-1)
+    mean = total / w + se
+    qm, sm = quant(mean.reshape(1, -1))
+    new_se = mean - dequant(qm, sm, nloc)[0]
+
+    # --- all-gather phase: broadcast every rank's quantized mean chunk ---
+    if not k:
+        qg = lax.all_gather(qm[0], axis_name)
+        sg = lax.all_gather(sm[0], axis_name)
+        out = dequant(qg, sg, nloc).reshape(-1)
+    else:
+        # hop A (inter): my inter group holds chunks {o*k + i, all o};
+        # hop B (intra): group members contribute their hop-A buffers.
+        # The payload stays in code form across both hops — gathers move
+        # the quantized bytes, values decode once at the end.
+        qa = lax.all_gather(qm[0], axis_name, axis_index_groups=groups_inter)
+        sa = lax.all_gather(sm[0], axis_name, axis_index_groups=groups_inter)
+        qb = lax.all_gather(qa, axis_name, axis_index_groups=groups_intra)
+        sb = lax.all_gather(sa, axis_name, axis_index_groups=groups_intra)
+        deq = dequant(qb.reshape(k * m_g, -1), sb.reshape(k * m_g, -1),
+                      nloc)
+        # qb is indexed [intra][outer]; global chunk c = outer*k + intra
+        out = deq.reshape(k, m_g, nloc).transpose(1, 0, 2).reshape(-1)
+    return out, new_we, new_se
+
+
+def quantized_all_reduce_gspmd(x, mesh, *, axis_name="data", bits=1,
+                               block_size=None, intra_size=0,
+                               worker_error=None, server_error=None):
+    """GSPMD entry for :func:`quantized_all_reduce`: callable from a
+    plain jit under ``mesh`` instead of inside shard_map.
+
+    ``x`` is the stacked per-device contribution of shape ``(w, n)``
+    with the leading dim sharded over ``axis_name`` (the engine's
+    residual-leaf layout); ``worker_error`` matches ``x`` and
+    ``server_error`` is ``(w, n // w)``.  The quantize -> exchange ->
+    dequantize core runs inside a leaf-level ``shard_map`` so the
+    compiled wire is the packed sub-byte payload (see
+    quantized_all_gather's docstring for why a sharding-constraint
+    formulation silently fattens back to fp32).
+
+    Returns ``(mean (n,) replicated, new_worker_error, new_server_error)``.
+    Differentiable in ``x`` with a straight-through vjp: the quantizer
+    passes the cotangent through unchanged, so ``d mean / d x_r = g/w``
+    broadcast back onto the per-device layout; residual outputs are
+    non-differentiable (their cotangents are dropped).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.parallel.mesh import constrain
+
+    w = int(mesh.shape[axis_name])
+    assert x.ndim == 2 and x.shape[0] == w, \
+        f"quantized_all_reduce_gspmd wants ({w}, n) stacked input, " \
+        f"got {x.shape}"
+    n = x.shape[1]
+    we = jnp.zeros_like(x) if worker_error is None else worker_error
+    se = jnp.zeros((w, max(1, n // max(w, 1))), jnp.float32) \
+        if server_error is None else server_error
+    row = P(axis_name, None)
+
+    def body(xs, wes, ses):
+        out, nwe, nse = quantized_all_reduce(
+            xs[0], axis_name, bits=bits, block_size=block_size,
+            intra_size=intra_size, worker_error=wes[0],
+            server_error=ses[0])
+        return out, nwe[None], nse[None]
+
+    def mapped(v, wes, ses):
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(row, row, row),
+            out_specs=(P(), row, row), axis_names={axis_name},
+            check_vma=False)(v, wes, ses)
+
+    @jax.custom_vjp
+    def ar(v):
+        return mapped(v, we, se)
+
+    def fwd(v):
+        return ar(v), None
+
+    def bwd(_, cts):
+        g_mean = cts[0]
+        return (constrain(
+            jnp.broadcast_to(g_mean[None, :] / w, (w, n)).astype(x.dtype),
+            row),)
+
+    ar.defvjp(fwd, bwd)
+    return ar(x)
+
+
+def quantized_error_feedback(x, worker_error, server_error, *, bits=1,
+                             block_size=None):
+    """Single-device twin of :func:`quantized_all_reduce` (w == 1): both
+    quantization stages run locally with persistent residuals, matching the
+    distributed numerics when every worker holds identical input (the
+    engine's already-mesh-averaged SPMD flow) — the blockwise analog of
+    :func:`quantize_with_error_feedback`.
+
+    Returns ``(out, new_worker_error, new_server_error)``; all three are
+    flat and ``x``-sized.
+    """
+    from deepspeed_tpu.runtime.quantization import (DEFAULT_BLOCK_SIZE,
+                                                    dequantize_rows,
+                                                    dequantize_signs_rows,
+                                                    quantize_rows,
+                                                    quantize_signs_rows)
+
+    if block_size is None:
+        block_size = DEFAULT_BLOCK_SIZE
+    assert bits in (1, 8)
+    if bits == 1:
+        def quant(rows):
+            return quantize_signs_rows(rows, block_size)
+
+        def dequant(q, s, n):
+            return dequantize_signs_rows(q, s, n, block_size=block_size)
+    else:
+        def quant(rows):
+            return quantize_rows(rows, block_size)
+
+        def dequant(q, s, n):
+            return dequantize_rows(q, s, n)
+
+    n = x.size
+    buf = x.astype(jnp.float32).reshape(-1) + worker_error.reshape(-1)
+    q, s = quant(buf.reshape(1, -1))
+    stage1 = dequant(q, s, n)[0]
+    new_we = buf - stage1
+    m = stage1 + server_error.reshape(-1)
+    q2, s2 = quant(m.reshape(1, -1))
+    out = dequant(q2, s2, n)[0]
+    return out, new_we, m - out
+
+
 def quantize_with_error_feedback(x, worker_error, server_error):
     """Single-device equivalent of compressed_allreduce (w == 1): two
     sequential sign-compressions with persistent residuals.

@@ -99,6 +99,97 @@ def quantize_blockwise_ef(x, residual, block_size: int = DEFAULT_BLOCK_SIZE):
 
 
 # ---------------------------------------------------------------------------
+# 1-bit sign quantization — the 0/1 Adam wire (arxiv 2202.06009) one rung
+# below qgZ: one SIGN BIT per element plus one fp32 scale per block, packed
+# 8 signs/byte.  ``scale_b = mean|x_b|`` (the L1-optimal magnitude for a
+# sign code; inf/nan propagate through the mean so overflow still trips the
+# loss scaler).  Dequantized value is ``sign * scale_b`` — padding tail
+# elements decode to +scale and MUST be sliced off by the caller; the
+# error-feedback residual absorbs the per-block magnitude loss.
+# ---------------------------------------------------------------------------
+
+_POW2 = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], dtype=jnp.uint8)
+
+
+def sign_pack_layout(n: int, block_size: int = DEFAULT_BLOCK_SIZE):
+    """(effective_block, n_blocks, padded_n, packed_bytes) for a row of
+    ``n`` elements under the 1-bit wire.  Extends ``block_layout`` with the
+    byte-packing quantum: signs pack 8/byte, so the padded row is rounded up
+    again to a multiple of 8 and ``packed_bytes = ceil(padded_n / 8)``.
+    Shared by the quantizers AND the analytic comm accounting — the two must
+    agree for the 1-bit accounting to be byte-accurate."""
+    bs, nb, npad = block_layout(n, block_size)
+    npack = -(-npad // 8) * 8
+    return bs, nb, npad, npack // 8
+
+
+def quantize_signs_rows(x, block_size: int = DEFAULT_BLOCK_SIZE):
+    """1-bit quantize each row of ``x`` (r, n) independently.
+
+    Returns ``(packed, scales)``: ``packed`` uint8 of shape (r, packed_bytes)
+    with 8 MSB-first sign bits per byte (bit set = non-negative), ``scales``
+    fp32 of shape (r, nb) holding per-block mean magnitudes.
+    """
+    r, n = x.shape
+    bs, nb, npad, nbytes = sign_pack_layout(n, block_size)
+    xf = x.astype(jnp.float32)
+    if npad != n:
+        xf = jnp.pad(xf, ((0, 0), (0, npad - n)))
+    scales = jnp.mean(jnp.abs(xf.reshape(r, nb, bs)), axis=-1)
+    bits = (xf >= 0).astype(jnp.uint8)                # nan -> sign bit 0
+    if nbytes * 8 != npad:
+        bits = jnp.pad(bits, ((0, 0), (0, nbytes * 8 - npad)))
+    packed = (bits.reshape(r, nbytes, 8) * _POW2).sum(
+        axis=-1, dtype=jnp.uint8)
+    return packed, scales
+
+
+def dequantize_signs_rows(packed, scales, n: int, dtype=jnp.float32,
+                          block_size: int = DEFAULT_BLOCK_SIZE):
+    """Inverse of quantize_signs_rows: (r, packed_bytes) uint8 + (r, nb)
+    scales -> (r, n) with each element ``±scale_of_its_block``."""
+    r = packed.shape[0]
+    bs, nb, npad, nbytes = sign_pack_layout(n, block_size)
+    bits = (packed[:, :, None] & _POW2[None, None, :]) > 0
+    signs = bits.reshape(r, nbytes * 8)[:, :npad].astype(
+        jnp.float32) * 2.0 - 1.0
+    out = signs.reshape(r, nb, bs) * scales[:, :, None]
+    return out.reshape(r, npad)[:, :n].astype(dtype)
+
+
+def quantize_signs_rows_np(x, block_size: int = DEFAULT_BLOCK_SIZE):
+    """numpy twin of quantize_signs_rows (bit-identical packing layout)."""
+    x = np.asarray(x, dtype=np.float32)
+    r, n = x.shape
+    bs, nb, npad, nbytes = sign_pack_layout(n, block_size)
+    if npad != n:
+        x = np.pad(x, ((0, 0), (0, npad - n)))
+    with np.errstate(invalid="ignore"):
+        scales = np.mean(np.abs(x.reshape(r, nb, bs)), axis=-1)
+    bits = (x >= 0).astype(np.uint8)
+    if nbytes * 8 != npad:
+        bits = np.pad(bits, ((0, 0), (0, nbytes * 8 - npad)))
+    pow2 = np.array([128, 64, 32, 16, 8, 4, 2, 1], dtype=np.uint8)
+    packed = (bits.reshape(r, nbytes, 8) * pow2).sum(-1).astype(np.uint8)
+    return packed, scales.astype(np.float32)
+
+
+def dequantize_signs_rows_np(packed, scales, n: int, dtype=np.float32,
+                             block_size: int = DEFAULT_BLOCK_SIZE):
+    r = packed.shape[0]
+    bs, nb, npad, nbytes = sign_pack_layout(n, block_size)
+    pow2 = np.array([128, 64, 32, 16, 8, 4, 2, 1], dtype=np.uint8)
+    bits = (packed[:, :, None] & pow2[None, None, :]) > 0
+    signs = bits.reshape(r, nbytes * 8)[:, :npad].astype(
+        np.float32) * 2.0 - 1.0
+    # invalid-multiply is expected: non-finite scales deliberately poison
+    # their block (overflow propagation, see module docstring)
+    with np.errstate(invalid="ignore"):
+        out = signs.reshape(r, nb, bs) * scales[:, :, None]
+    return out.reshape(r, npad)[:, :n].astype(dtype)
+
+
+# ---------------------------------------------------------------------------
 # numpy twins — host side of the ZeRO-Offload qwZ push (quantize on the host,
 # upload int8, dequantize after the on-device all-gather)
 # ---------------------------------------------------------------------------

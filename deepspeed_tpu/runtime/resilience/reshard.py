@@ -112,6 +112,23 @@ def topology_manifest(engine):
         specs = partition_specs(engine)
         if specs is not None:
             topo["partition_specs"] = specs
+    opt = getattr(engine, "optimizer", None)
+    if getattr(opt, "axis_name", None) is not None:
+        # 1-bit/0-1 wire state: error-feedback residuals and the local
+        # accumulator carry a leading per-device (axis_size,) dim — a
+        # dp-change load cannot remap old per-device error memories, so
+        # the manifest records what was written and the load side resets
+        # them (DISARM-warning) when the axis changed.  The freeze /
+        # local-step phase needs no flag here: it re-derives purely from
+        # the restored step counters (zeroone_cadence, _onebit_frozen).
+        comp = {"optimizer": getattr(opt, "name", type(opt).__name__),
+                "axis_name": str(opt.axis_name),
+                "axis_size": int(getattr(opt, "axis_size", 0) or 0)}
+        for k in ("freeze_step", "var_freeze_step", "local_steps",
+                  "local_step_scaler", "local_step_clipper", "bits"):
+            if hasattr(opt, k):
+                comp[k] = int(getattr(opt, k))
+        topo["compression"] = comp
     return topo
 
 
@@ -299,6 +316,15 @@ def plan_elastic_load(saved_topo, engine):
                 f"data-parallel degree changed (dp {s_dp} -> "
                 f"{engine.dp_world_size}); replicated state re-placed on "
                 f"the new mesh")
+
+    saved_comp = saved_topo.get("compression")
+    if saved_comp is not None and "dp" in plan["changed"]:
+        plan["resharded"].append(
+            f"per-device {saved_comp.get('optimizer')} compression state "
+            f"(error-feedback residuals, local accumulator) written at "
+            f"axis_size={saved_comp.get('axis_size')} reset to zero on "
+            f"the new data axis; freeze/local-step phase re-derived from "
+            f"the restored step counters")
 
     saved_pipe = saved_topo.get("pipe")
     if saved_pipe is not None and hasattr(engine, "num_stages"):

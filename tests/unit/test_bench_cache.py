@@ -44,11 +44,18 @@ def test_cfg_hash_stable_and_spec_sensitive():
     # shape in the phase cache (and vice versa)
     assert b._cfg_hash({"model": "gpt2-125m", "batch": 8,
                         "chaos": "rank-kill"}, base) != h1
+    # the zeroone rung (PR 18) is its own config identity: a dead 0/1
+    # Adam A/B must not shadow the dense rung of the same shape in the
+    # phase cache (and vice versa)
+    assert b._cfg_hash({"model": "gpt2-125m", "batch": 8,
+                        "optimizer": "zeroone"}, base) != h1
     with open(os.path.join(REPO, "bench.py")) as f:
         src = f.read()
     assert '"zero_stage": 3' in src, "bench ladder lost its stage-3 rung"
     assert '"chaos": "rank-kill"' in src, \
         "bench ladder lost its failure-injection rung"
+    assert '"optimizer": "zeroone"' in src, \
+        "bench ladder lost its 0/1 Adam rung"
 
 
 def test_cache_roundtrip_and_corruption_tolerance(tmp_path):

@@ -39,6 +39,25 @@ def test_quantized_configs_stay_cheaper_than_dense():
         hier["grad_exchange_bytes_per_step"] / 4
 
 
+def test_zeroone_wire_beats_qgz_by_4x():
+    """The PR-18 acceptance bound, budget-gated: the 0/1 Adam optimizer
+    wire's amortized grad-exchange bytes/step (1-bit signs + fp32 block
+    scales, one synced round per k=2-step round) <= 1/4 of the flat qgZ
+    int8 wire on the gpt2-350m-ish dp8 shape set — flat AND hierarchical.
+    Local rounds are priced at ZERO bytes (the HLO contract pins the
+    compiled program to that)."""
+    vols = compute_volumes()
+    for name in ("gpt2-350m-ish/dp8/zeroone-1bit/flat-k2",
+                 "gpt2-350m-ish/dp8/zeroone-1bit/hier4-k2"):
+        z = vols[name]
+        assert z["local_round_bytes"] == 0
+        assert z["total_bytes_per_step"] * 4 <= \
+            z["qgz_int8_wire_bytes_per_step"], (name, z)
+        # amortization is honest: the per-sync-round figure is exactly
+        # k x the per-step figure (k=2), not hidden
+        assert abs(z["sync_round_bytes"] - 2 * z["total_bytes_per_step"]) <= 1
+
+
 def test_growth_detected():
     """A >10% regression against the budget fails; <=10% passes."""
     vols = compute_volumes()

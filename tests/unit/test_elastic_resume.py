@@ -748,3 +748,114 @@ def test_emergency_checkpoint_records_data_position(tmp_path):
     assert pos["samples_consumed"] == \
         pos["micro_steps"] * pos["micro_batch_per_gpu"] * pos["dp_world_size"]
     assert meta["data_position"] == pos
+
+
+# ---------------------------------------------------------------------------
+# 1-bit/0-1 compression state across a dp change (PR-18 satellite bugfix)
+# ---------------------------------------------------------------------------
+
+def zeroone_engine(dp, micro, gas, var_freeze_step=2, local_steps=2):
+    cfg = {
+        "train_batch_size": micro * gas * dp,
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 100,
+        "optimizer": {"type": "ZeroOneAdam",
+                      "params": {"lr": 0.01,
+                                 "var_freeze_step": var_freeze_step,
+                                 "local_steps": local_steps}},
+        "mesh": {"data": dp, "allow_partial": True},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(HIDDEN), config_params=cfg)
+    return engine
+
+
+def test_manifest_carries_compression_state(tmp_path):
+    """The topology manifest must record the wire optimizer's per-device
+    axis so an elastic load can tell residuals written elsewhere."""
+    e = zeroone_engine(dp=4, micro=2, gas=1)
+    it = random_dataloader(HIDDEN, 64, 8)
+    for _ in range(3):
+        e.train_batch(data_iter=it)
+    e.save_checkpoint(str(tmp_path), backend="npz")
+    topo = read_topology(str(tmp_path / "global_step3"))
+    comp = topo["compression"]
+    assert comp["optimizer"] == "zerooneadam"
+    assert comp["axis_name"] == "data" and comp["axis_size"] == 4
+    assert comp["var_freeze_step"] == 2 and comp["local_steps"] == 2
+
+
+def test_zeroone_same_dp_resume_keeps_residuals_bitexact(tmp_path):
+    """No topology change: EF residuals and the local accumulator ride
+    the checkpoint untouched."""
+    e = zeroone_engine(dp=4, micro=2, gas=1)
+    it = random_dataloader(HIDDEN, 64, 8)
+    for _ in range(4):   # 2 warmup + (local, sync): residuals are live
+        e.train_batch(data_iter=it)
+    we_src = np.asarray(jax.device_get(
+        jax.tree_util.tree_leaves(e.state.opt_state.worker_error)[0]))
+    assert np.abs(we_src).sum() > 0
+    e.save_checkpoint(str(tmp_path), tag="t", backend="npz")
+
+    e2 = zeroone_engine(dp=4, micro=2, gas=1)
+    it2 = random_dataloader(HIDDEN, 64, 8, seed=9)
+    e2.init_from_batch(next(it2))
+    path, _ = e2.load_checkpoint(str(tmp_path), tag="t")
+    assert path is not None
+    we_new = np.asarray(jax.device_get(
+        jax.tree_util.tree_leaves(e2.state.opt_state.worker_error)[0]))
+    assert we_src.tobytes() == we_new.tobytes()
+
+
+def test_zeroone_dp_change_resets_residuals_loudly(tmp_path, caplog):
+    """dp-change resume: the per-device EF residuals/accumulator cannot
+    remap onto the new axis — they must reset to zeros with a DISARMED
+    warning (the old bug: device_put silently misshaped the TrainState),
+    while every replicated leaf (params, m, v) stays bit-exact and the
+    cadence phase re-derives from the restored counters."""
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    src = zeroone_engine(dp=4, micro=2, gas=1)
+    it = random_dataloader(HIDDEN, 64, 8)
+    for _ in range(4):   # crosses var_freeze_step=2: residuals are live
+        src.train_batch(data_iter=it)
+    assert np.abs(np.asarray(jax.device_get(jax.tree_util.tree_leaves(
+        src.state.opt_state.worker_error)[0]))).sum() > 0
+    src.save_checkpoint(str(tmp_path), tag="t", backend="npz")
+    m_src = np.asarray(jax.device_get(
+        jax.tree_util.tree_leaves(src.state.opt_state.m)[0]))
+
+    e2 = zeroone_engine(dp=2, micro=2, gas=2)  # same global batch
+    it2 = random_dataloader(HIDDEN, 64, 4, seed=9)
+    e2.init_from_batch(next(it2))
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            path, client = e2.load_checkpoint(str(tmp_path), tag="t",
+                                              elastic=True)
+    finally:
+        ds_logger.propagate = False
+    assert path is not None and e2.global_steps == 4
+    msgs = [r.message for r in caplog.records if "DISARMED" in r.message]
+    assert msgs and "worker_error" in " ".join(msgs)
+    # the reshard plan names the reset
+    plan = client["elastic_reshard"]
+    assert any("compression state" in line for line in plan["resharded"])
+    # residual leaves: current-axis shapes, zeroed
+    for leaf in (jax.tree_util.tree_leaves(e2.state.opt_state.worker_error)
+                 + jax.tree_util.tree_leaves(e2.state.opt_state.local_accum)
+                 + jax.tree_util.tree_leaves(e2.state.opt_state.server_error)):
+        got = np.asarray(jax.device_get(leaf))
+        assert got.shape[0] == 2, got.shape
+        assert np.abs(got).sum() == 0
+    # replicated moments survived bit-exact
+    m_new = np.asarray(jax.device_get(
+        jax.tree_util.tree_leaves(e2.state.opt_state.m)[0]))
+    assert m_src.tobytes() == m_new.tobytes()
+    # phase re-derives from counters: 4 optimizer steps with freeze=2,
+    # k=2 -> rounds (local, sync) -> next step starts a local round
+    assert e2._zeroone_phase() == ("local", 2)
+    # and the resumed run keeps training
+    losses = losses_of(e2, it2, 3)
+    assert np.isfinite(losses).all()

@@ -1501,3 +1501,161 @@ def test_disarmed_discipline_cache_and_spec_arming(bad, good):
                            rules=["disarmed-discipline"])) \
         == ["disarmed-discipline"]
     assert lint(good, path, rules=["disarmed-discipline"]) == []
+
+
+# ---------------------------------------------------------------------------
+# 0/1 Adam wire (PR 18): arming discipline + hot step/pack fn coverage
+# ---------------------------------------------------------------------------
+
+DISARM_ZEROONE_BAD = """
+class E:
+    def _arm_zeroone(self, params):
+        self._zeroone_armed = False
+        if self.dp_world_size <= 1 or self.zero_optimization_stage() != 0:
+            return False
+        self._zeroone_armed = True
+        return True
+"""
+
+DISARM_ZEROONE_GOOD = """
+class E:
+    def _arm_zeroone(self, params):
+        self._zeroone_armed = False
+        blockers = []
+        if self.dp_world_size <= 1:
+            blockers.append("data-parallel degree is 1")
+        if self.zero_optimization_stage() != 0:
+            blockers.append("zero_optimization.stage shards the "
+                            "accumulator")
+        if blockers:
+            log_dist("ZeroOneAdam: wire compression DISARMED - "
+                     f"({', '.join(blockers)})", ranks=[0],
+                     level=logging.WARNING)
+            return False
+        self._zeroone_armed = True
+        return True
+"""
+
+DISARM_QAR_BAD = """
+class E:
+    def _arm_quantized_allreduce(self, dp, params=None):
+        self._qar_armed = False
+        if dp <= 1:
+            return 0
+        self._qar_armed = True
+        return self._resolve_intra(dp, params)
+"""
+
+DISARM_QAR_GOOD = """
+class E:
+    def _arm_quantized_allreduce(self, dp, params=None):
+        self._qar_armed = False
+        if dp <= 1:
+            log_dist("quantized_all_reduce: DISARMED - data-parallel "
+                     "degree is 1, no wire to shrink", ranks=[0],
+                     level=logging.WARNING)
+            return 0
+        self._qar_armed = True
+        return self._resolve_intra(dp, params)
+"""
+
+
+@pytest.mark.parametrize("bad,good,name", [
+    (DISARM_ZEROONE_BAD, DISARM_ZEROONE_GOOD, "_arm_zeroone"),
+    (DISARM_QAR_BAD, DISARM_QAR_GOOD, "_arm_quantized_allreduce"),
+])
+def test_disarmed_discipline_covers_zeroone_arming(bad, good, name):
+    """PR 18 satellite: the 0/1 Adam wire arming decisions follow the
+    armed-or-warns discipline — silently falling back to the dense
+    optimizer path (or the flat wire) fires; a DISARMED warn naming the
+    blockers (dp=1, zero stage, offload, sparse grads) is quiet."""
+    path = "deepspeed_tpu/runtime/engine.py"
+    got = lint(bad, path, rules=["disarmed-discipline"])
+    assert rule_names(got) == ["disarmed-discipline"]
+    assert name in got[0].message
+    assert lint(good, path, rules=["disarmed-discipline"]) == []
+
+
+# phase selection that re-reads a device counter per step — the exact
+# serialization the _zeroone_frozen_latch exists to avoid
+HS_ZEROONE_STEP_BAD = """
+class E:
+    def _zeroone_phase(self):
+        while self._pending:
+            s = int(self._step_counter.item())
+            self._pending.pop()
+        return self.optimizer.cadence(s)
+"""
+
+HS_ZEROONE_STEP_GOOD = """
+class E:
+    def _zeroone_phase(self):
+        return self.optimizer.cadence(self.global_steps -
+                                      self.skipped_steps)
+"""
+
+# a sign-pack kernel that syncs per block — inside every sync round's
+# program this would stall the wire once per 128 floats
+HS_PACK_BAD = """
+def quantize_signs_rows(x, block_size=128):
+    scales = []
+    for blk in split_blocks(x, block_size):
+        scales.append(float(jax.device_get(abs_mean(blk))))
+    return pack_bits(x), scales
+"""
+
+HS_PACK_GOOD = """
+def quantize_signs_rows(x, block_size=128):
+    blocks = reshape_blocks(x, block_size)
+    scales = abs_mean(blocks)
+    return pack_bits(x), scales
+"""
+
+
+def test_host_sync_covers_zeroone_step_and_pack_fns():
+    """PR 18 satellite: the per-step phase selector (engine.py) and the
+    sign pack/quantize kernels (quantization.py / custom_collectives.py)
+    are hot — a device sync in any of their loops fires; pure host
+    bookkeeping / straight-line array math is quiet."""
+    epath = "deepspeed_tpu/runtime/engine.py"
+    got = lint(HS_ZEROONE_STEP_BAD, epath, rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"]
+    assert "per-iteration loop" in got[0].message
+    assert lint(HS_ZEROONE_STEP_GOOD, epath, rules=["host-sync"]) == []
+    for qpath in ("deepspeed_tpu/runtime/quantization.py",
+                  "deepspeed_tpu/runtime/custom_collectives.py"):
+        got = lint(HS_PACK_BAD, qpath, rules=["host-sync"])
+        assert rule_names(got) == ["host-sync"], qpath
+        assert lint(HS_PACK_GOOD, qpath, rules=["host-sync"]) == []
+    # scope: the same pack loop outside the wire files is plain host code
+    assert lint(HS_PACK_BAD, "tools/somefile.py", rules=["host-sync"]) == []
+
+
+HS_REARM_BAD = """
+class E:
+    def train_batch(self, batch):
+        self._arm_zeroone(self._opt_params)
+        self._compile_zeroone()
+        return self._jit_micro(batch)
+"""
+
+HS_REARM_GOOD = """
+class E:
+    def _configure_optimizer(self):
+        if self._arm_zeroone(self._opt_params):
+            self._intra = self._arm_quantized_allreduce(self.dp)
+
+    def train_batch(self, batch):
+        return self._jit_micro(batch)
+"""
+
+
+def test_host_sync_flags_zeroone_rearm_in_hot_fn():
+    """PR 18 satellite: re-arming the wire (blocker scan + program-cache
+    rebuild) from a hot step fn is flagged as cold-builder work — arm
+    once at configure time, reuse the decision."""
+    path = "deepspeed_tpu/runtime/engine.py"
+    got = lint(HS_REARM_BAD, path, rules=["host-sync"])
+    assert rule_names(got) == ["host-sync", "host-sync"]
+    assert "arming time" in got[0].message
+    assert lint(HS_REARM_GOOD, path, rules=["host-sync"]) == []

@@ -646,6 +646,28 @@ def test_perf_trend_payload_appends_current_round(tmp_path):
     assert out["dead_rounds"] == []
 
 
+def test_perf_trend_optimizer_wire_gaps_honest(tmp_path):
+    """PR 18: the 0/1 Adam optimizer-wire scalar trends only on rounds
+    that ran the --optimizer zeroone A/B; rounds without it show None
+    (an honest gap), never a zero-byte wire or a fake vs-qgZ win."""
+    from tools import perf_trend
+
+    _bench_round(tmp_path, 1, {"metric": "dense TFLOPS", "value": 20.0,
+                               "unit": "TFLOPS/chip"})
+    _bench_round(tmp_path, 2, {
+        "metric": "0/1 Adam post-freeze step time vs fused Adam",
+        "value": 1.02, "unit": "x step-time vs dense Adam",
+        "optimizer_wire_bytes_per_step": 48480320,
+        "optimizer_wire_vs_qgz": 0.152})
+    rows = perf_trend.trend_rows(perf_trend.load_rounds(root=str(tmp_path)))
+    assert rows[0]["optimizer_wire_bytes_per_step"] is None
+    assert rows[0]["optimizer_wire_vs_qgz"] is None
+    assert rows[1]["optimizer_wire_bytes_per_step"] == 48480320
+    out = perf_trend.trend_payload(root=str(tmp_path))
+    assert out["rounds"][0]["optimizer_wire_vs_qgz"] is None
+    assert out["rounds"][1]["optimizer_wire_vs_qgz"] == 0.152
+
+
 def test_perf_trend_real_repo_rounds_parse():
     """The real BENCH_r*.json history (wrapper format, truncated tails)
     must load without crashing and expose r03's published number."""

@@ -78,6 +78,16 @@ CONFIGS = {
         shapes=GPT2ISH, dp=8, param_gathers=2),
     "gpt2-350m-ish/dp8/stage3/scheduled-int8": dict(
         shapes=GPT2ISH, dp=8, quantized_weights=True, param_gathers=1),
+    # 0/1 Adam optimizer wire (runtime/custom_collectives.
+    # quantized_all_reduce): synced rounds move packed sign bits + fp32
+    # block scales, local rounds move ZERO bytes, and one synced round
+    # stands in for local_steps_k optimizer steps — the amortized figure
+    # is the budget, and the qgz yardstick key gates the acceptance
+    # bound (amortized <= 1/4 of the qgZ int8 wire, test_comm_budget)
+    "gpt2-350m-ish/dp8/zeroone-1bit/flat-k2": dict(
+        shapes=GPT2ISH, dp=8, zeroone=True, local_steps_k=2),
+    "gpt2-350m-ish/dp8/zeroone-1bit/hier4-k2": dict(
+        shapes=GPT2ISH, dp=8, zeroone=True, local_steps_k=2, intra_size=4),
     "mlp16/dp8/stage2/dense": dict(shapes=MLP16, dp=8,
                                    quantized_gradients=False),
     "mlp16/dp8/stage2/qgz": dict(shapes=MLP16, dp=8,
@@ -120,6 +130,25 @@ def compute_volumes():
                 "decode_allreduce_bytes_per_step":
                     sum(c.bytes_per_step for c in colls
                         if c.op == "all-reduce"),
+            }
+            continue
+        if cfg.get("zeroone"):
+            # every leaf rides the wire (params replicated, stage 0):
+            # shard_dim is irrelevant to the packed all-reduce
+            rep = ca.zeroone_volume_report(
+                [ca.LeafSpec(name=n, shape=s, shard_dim=None)
+                 for n, s in cfg["shapes"]],
+                cfg["dp"], bits=cfg.get("bits", 1),
+                block_size=cfg.get("block_size", 128),
+                intra_size=cfg.get("intra_size", 0),
+                local_steps_k=cfg.get("local_steps_k", 1))
+            out[name] = {
+                "total_bytes_per_step":
+                    rep["amortized_grad_exchange_bytes_per_step"],
+                "sync_round_bytes": rep["sync_round_bytes"],
+                "local_round_bytes": rep["local_round_bytes"],
+                "qgz_int8_wire_bytes_per_step":
+                    rep["baseline"]["qgz_int8_wire_bytes_per_step"],
             }
             continue
         if "pipe" in cfg:

@@ -48,6 +48,11 @@ HOT_FILES = {
     "deepspeed_tpu/runtime/resilience/supervisor.py",
     "deepspeed_tpu/runtime/resilience/integrity.py",
     "deepspeed_tpu/runtime/resilience/transport.py",
+    # the quantized wire (PR 18): pack/quantize kernels and the
+    # collective bodies run inside every sync round's traced program —
+    # a host sync in any of their loops stalls the optimizer wire
+    "deepspeed_tpu/runtime/quantization.py",
+    "deepspeed_tpu/runtime/custom_collectives.py",
 }
 HOT_FN_RE = re.compile(
     r"^(train_batch|eval_batch|forward|backward|step"
@@ -101,7 +106,14 @@ HOT_FN_RE = re.compile(
     # a sync per tree node, per draft token or per lane would serialize
     # admission and decode against the host
     r"|prefix_\w+|_cow_copy\w*|_reclaim_\w+|warm_cow|cached_blocks"
-    r"|_touch|_rank_slot|_prefix_probe|_draft_\w+|_spec_\w+)$")
+    r"|_touch|_rank_slot|_prefix_probe|_draft_\w+|_spec_\w+"
+    # 0/1 Adam wire (PR 18): the phase/wire selectors run once per
+    # train_batch step (pure host bookkeeping on counters — a device
+    # read there re-serializes the step clock the latch exists to
+    # protect), and the sign pack/quantize kernels + collective
+    # round-trip helpers execute inside every sync round's program
+    r"|_zeroone_\w+|quantize_\w+|dequantize_\w+|pack_signs\w*"
+    r"|unpack_signs\w*|sign_pack_layout|compressed_allreduce)$")
 # benchmark drivers: every loop is (or brackets) a timed region — a sync
 # per iteration pollutes the measured step time with transfer latency
 BENCH_FILES = {"bench.py", "tools/pipe_bench.py", "tools/serve_bench.py"}
@@ -128,7 +140,14 @@ COLD_BUILDER_NAMES = {"build_gather_plan", "_arm_stage3",
                       "memory_report", "measured_memory",
                       "device_memory_report", "train_memory_report",
                       "_analytic_memory_components",
-                      "_arm_memory_accounting"}
+                      "_arm_memory_accounting",
+                      # 0/1 Adam arming + program-cache build (PR 18):
+                      # blocker scans and the per-(phase, k) jit cache
+                      # setup are arming/compile-time work — re-arming
+                      # per step would rebuild the wire decision (and
+                      # its WARNING spam) on every train_batch
+                      "_arm_zeroone", "_arm_quantized_allreduce",
+                      "_compile_zeroone"}
 
 SYNC_METHOD_ATTRS = {"item", "block_until_ready"}
 SYNC_FN_NAMES = {"device_get", "block_until_ready"}

@@ -132,6 +132,14 @@ def trend_rows(rounds):
                 # everything", nor a None tokens-per-verify as 1.0
                 "prefix_hit_rate": payload.get("prefix_hit_rate"),
                 "tokens_per_verify": payload.get("tokens_per_verify"),
+                # optimizer wire (PR 18 --optimizer zeroone rung): rounds
+                # without the 0/1 Adam A/B lack the keys and show as
+                # honest gaps — a None must never read as "zero bytes
+                # moved", nor a None ratio as "beat qgZ"
+                "optimizer_wire_bytes_per_step":
+                    payload.get("optimizer_wire_bytes_per_step"),
+                "optimizer_wire_vs_qgz":
+                    payload.get("optimizer_wire_vs_qgz"),
                 "trace": tel.get("trace"),
                 "metrics_jsonl": tel.get("metrics_jsonl"),
             })
@@ -182,7 +190,8 @@ def trend_payload(pattern=DEFAULT_GLOB, root=".",
                      "mttr_steps_mean", "detection_latency_steps",
                      "corruption_recovered", "peak_hbm_bytes",
                      "hbm_delta_vs_analytic", "prefix_hit_rate",
-                     "tokens_per_verify")} for r in rows],
+                     "tokens_per_verify", "optimizer_wire_bytes_per_step",
+                     "optimizer_wire_vs_qgz")} for r in rows],
         "dead_rounds": [r["round"] for r in rows if not r["ok"]],
         "regression": check_regression(rows, threshold),
     }
@@ -217,9 +226,11 @@ def main(argv=None):
     else:
         print(f"{'round':>5} {'ok':>3} {'value':>10} {'mfu':>7} "
               f"{'step_ms':>9} {'tok/s':>12} {'det.lat':>8} {'recov':>6} "
-              f"{'hbm_GiB':>8} {'pfx_hit':>8} {'tok/ver':>8}  metric")
+              f"{'hbm_GiB':>8} {'pfx_hit':>8} {'tok/ver':>8} "
+              f"{'wire_MB':>8}  metric")
         for r in rows:
             hbm = r.get("peak_hbm_bytes")
+            wire = r.get("optimizer_wire_bytes_per_step")
             print(f"{r['round']:>5} {'y' if r['ok'] else 'n':>3} "
                   f"{_fmt(r.get('value')):>10} {_fmt(r.get('mfu'), 4):>7} "
                   f"{_fmt(r.get('step_ms'), 1):>9} "
@@ -228,7 +239,8 @@ def main(argv=None):
                   f"{_fmt(r.get('corruption_recovered')):>6} "
                   f"{_fmt(hbm / 2**30 if hbm else None, 2):>8} "
                   f"{_fmt(r.get('prefix_hit_rate'), 3):>8} "
-                  f"{_fmt(r.get('tokens_per_verify'), 3):>8}  "
+                  f"{_fmt(r.get('tokens_per_verify'), 3):>8} "
+                  f"{_fmt(wire / 2**20 if wire else None, 2):>8}  "
                   f"{(r.get('metric') or '-')[:60]}")
         if verdict["baseline"]:
             word = "REGRESSED" if verdict["regressed"] else "ok"

@@ -144,7 +144,9 @@ def _telemetry_paths(args):
     stamp = (f"{args.model}_b{args.batch}_s{args.seq}"
              f"_{os.getpid()}_{time.time_ns()}")
     return {"metrics": os.path.join(tdir, f"metrics_{stamp}.jsonl"),
-            "trace": os.path.join(tdir, f"trace_{stamp}.json")}
+            "trace": os.path.join(tdir, f"trace_{stamp}.json"),
+            "program_lint": os.path.join(tdir,
+                                         f"program_lint_{stamp}.json")}
 
 
 def _worker_setup(args):
@@ -369,8 +371,38 @@ def _run_one(args, ctx) -> int:
             # must never cost the round its perf number
             print(f"[bench] telemetry_report failed: {e}",
                   file=sys.stderr, flush=True)
+        # program-lint artifact (ISSUE 19): hold THIS round's compiled
+        # programs to their registered contracts and ship the findings
+        # next to the telemetry digest — a wire that silently re-widened
+        # or a dropped donation shows up attached to the very round
+        # whose perf number it poisoned.  No baseline: the artifact
+        # reports everything, CI policy lives in the --programs run.
+        lint_path = None
+        try:
+            from tools.graftlint.program_lint import (lint_programs,
+                                                      program_rules)
+            from tools.graftlint.core import report_json
+
+            result = lint_programs([engine.program_registry],
+                                   use_baseline=False)
+            payload = json.loads(report_json(result, program_rules()))
+            payload["programs"] = {engine.program_registry.engine:
+                                   engine.program_registry.summary()}
+            with open(tele_paths["program_lint"], "w",
+                      encoding="utf-8") as f:
+                json.dump(payload, f, indent=2, sort_keys=True)
+            lint_path = tele_paths["program_lint"]
+            if result.new:
+                print(f"[bench] program lint: {len(result.new)} contract "
+                      f"violation(s) in this round's programs — see "
+                      f"{lint_path}", file=sys.stderr, flush=True)
+        except Exception as e:  # lint: allow-broad-except — the lint
+            # artifact must never cost the round its perf number
+            print(f"[bench] program lint failed: {e}", file=sys.stderr,
+                  flush=True)
         telemetry_out = {"metrics_jsonl": tele_paths["metrics"],
-                         "trace": trace_path, "mfu": mfu_rep}
+                         "trace": trace_path, "mfu": mfu_rep,
+                         "program_lint": lint_path}
 
     # memory accounting (ISSUE 15): measured HBM watermark + delta vs
     # the analytic model, once per attempt AFTER the timed region.

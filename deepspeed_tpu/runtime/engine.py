@@ -1038,6 +1038,14 @@ class DeepSpeedEngine:
             TELEMETRY_TRACE, TELEMETRY_TRACE_CAPACITY)
 
         tc = self._config.telemetry
+        # the compiled-program registry is ALWAYS on: registration is a
+        # shape capture + dict insert once per jit (no compile, no device
+        # work), and it is the seam tools/graftlint/program_lint.py and
+        # ROADMAP item 5's plan compiler read — telemetry arming only
+        # gates the FLOP/memory ledgers below
+        from deepspeed_tpu.telemetry import ProgramRegistry
+
+        self._programs = ProgramRegistry("base")
         self._telemetry = None
         self._tracer = None
         self._chaos_observer = None
@@ -1143,8 +1151,26 @@ class DeepSpeedEngine:
             return None
         return tr.export_chrome_trace(path, complete_events=complete_events)
 
+    @property
+    def program_registry(self):
+        """The engine's compiled-program registry (always armed): every
+        jit the engine has dispatched, with its declarative HLO contract.
+        Read by ``python -m tools.graftlint --programs``."""
+        return self._programs
+
+    def _register_program(self, name, jit_fn, args, contract=None,
+                          calls_per_step=1.0):
+        """Register one jit with the always-on program registry (shape
+        capture + dict insert; the lower().compile() is lazy and happens
+        only when a lint/report pass reads the entry)."""
+        from deepspeed_tpu.telemetry import register_program
+
+        register_program(self._programs, name, jit_fn, args,
+                         mesh=self.mesh, contract=contract,
+                         calls_per_step=calls_per_step)
+
     def _register_mfu_jit(self, name, jit_fn, args, calls_per_step=1.0,
-                          mem_label=None):
+                          mem_label=None, program_name=None, contract=None):
         """Capture-by-shape registration of a dispatched jit with the MFU
         ledger AND the measured-memory ledger: a ShapeDtypeStruct tree of
         the REAL dispatch args is taken once (first dispatch; donated
@@ -1154,7 +1180,14 @@ class DeepSpeedEngine:
         object per name (``MemoryAccounting(shared=...)``), so arming
         both costs ONE compile per jit.  ``mem_label`` additionally arms
         the analytic-vs-measured transient cross-check for jits the
-        engine makes a budget claim about."""
+        engine makes a budget claim about.  The program registry is fed
+        FIRST and unconditionally (``program_name`` names the program
+        when one MFU slot covers several compiled variants, e.g. the 0/1
+        Adam per-(phase, k) fused programs; ``contract`` declares the
+        entry's HLO contract for tools/graftlint/program_lint.py)."""
+        self._register_program(program_name or name, jit_fn, args,
+                               contract=contract,
+                               calls_per_step=calls_per_step)
         tel = self._telemetry
         if tel is None:
             return
@@ -2690,6 +2723,99 @@ class DeepSpeedEngine:
             return self._onebit_apply_jits[frozen]
         return self._jit_apply
 
+    # ------------------------------------------------------------------
+    # program-registry contracts (telemetry/programs.py): the HLO claims
+    # each compiled variant must keep, read by program_lint's autopilot
+    # ------------------------------------------------------------------
+    def _micro_program_contract(self):
+        """Contract of the per-micro jit: pure device work, donated
+        state; under qgZ (stages 1/2) the gradient exchange it carries
+        rides the s8 wire within the analytic per-micro budget."""
+        contract = {"host_transfer_free": True, "donates_argnums": (0,)}
+        if getattr(self, "_qgz_armed", False) \
+                and self.zero_optimization_stage() != 3:
+            contract.update(
+                wire_dtype="s8",
+                comm_budget_key="grad_exchange_bytes_per_step",
+                # resolved lazily at lint time: the analytic report needs
+                # built state, and the per-step figure covers gas micros
+                comm_budget_bytes=lambda: (
+                    self.comm_volume_report()["grad_exchange_bytes_per_step"]
+                    / max(1, self.gradient_accumulation_steps())))
+        return contract
+
+    def _optimizer_wire_sync_contract(self):
+        """The 0/1 Adam sync-round wire contract: packed u8/s8 payloads
+        plus fp32 block scales; total payload within the analytic
+        sync-round budget × dp/(dp-1) ring slack (HLO counts gathered
+        OUTPUT bytes), scalar overflow/loss syncs (<= 8 elements)
+        excluded."""
+        dp = self.dp_world_size
+
+        def budget():
+            ow = self.comm_volume_report(refresh=True)["optimizer_wire"]
+            return ow["sync_round_bytes"] * dp / max(1, dp - 1) + 1
+
+        return {
+            "wire_dtype": ("u8", "s8"),
+            "comm_budget_key": "optimizer_wire.sync_round_bytes",
+            "comm_budget_bytes": budget,
+            "comm_small_op_cutoff": 8,
+        }
+
+    def _fused_program_spec(self):
+        """(program_name, contract) of the fused-train-step variant the
+        NEXT dispatch runs — 0/1 Adam and 1-bit Adam compile one program
+        per (phase, k)/frozen state, each with its own wire contract.
+        The rng key / step scalars pass through a lax.cond unaliased
+        (out_shardings suppresses their buffer-donor entries too), hence
+        the donation floor."""
+        base = {"host_transfer_free": True, "donates_argnums": (0,),
+                "donation_min_elements": 4}
+        if self._zeroone_wire():
+            phase, k = self._zeroone_phase()
+            contract = dict(base)
+            if phase == "local":
+                # skipped round: NO cross-device collective at all —
+                # zero wire bytes is what makes the k-round amortization
+                # in comm_accounting honest
+                contract["collective_free"] = True
+            elif phase == "sync":
+                contract.update(self._optimizer_wire_sync_contract())
+            return f"zeroone_fused:{phase}_k{k}", contract
+        if getattr(self, "_onebit_fused_fns", None):
+            frozen = self._onebit_frozen()
+            contract = dict(base)
+            if frozen:
+                # post-freeze 1-bit wire: bit-packed signs + fp32 scales
+                contract["wire_dtype"] = ("u8", "s8")
+            return f"onebit_fused:{'frozen' if frozen else 'warmup'}", \
+                contract
+        return "fused_train_step", base
+
+    def _apply_program_spec(self):
+        """(program_name, contract) of the optimizer-apply variant the
+        NEXT dispatch runs (micro-accumulation path).  Donation floor as
+        in :meth:`_fused_program_spec` — the rng key rides the cond
+        unaliased."""
+        base = {"donates_argnums": (0,), "donation_min_elements": 4}
+        if self._zeroone_wire():
+            phase, k = self._zeroone_phase()
+            contract = dict(base)
+            if phase == "local":
+                contract["collective_free"] = True
+            elif phase == "sync":
+                contract.update(self._optimizer_wire_sync_contract())
+            return f"zeroone_apply:{phase}_k{k}", contract
+        if getattr(self, "_onebit_apply_fns", None):
+            frozen = self._onebit_frozen()
+            contract = dict(base)
+            if frozen:
+                contract["wire_dtype"] = ("u8", "s8")
+            return f"onebit_apply:{'frozen' if frozen else 'warmup'}", \
+                contract
+        return "apply_step", base
+
     def _compile(self):
         if self._jit_micro is not None:
             return
@@ -3036,11 +3162,26 @@ class DeepSpeedEngine:
                 # scheduled stage-3: the forward does NOT donate the state
                 # — it stays alive; what stages is the vjp stash, whose
                 # residuals hold the once-gathered weights for backward
+                n_gathered = getattr(
+                    getattr(self, "_s3_plan", None), "n_gathered_leaves",
+                    None)
                 self._register_mfu_jit(
                     "s3_fwd", self._jit_s3_fwd, (self.state, dev_batch),
                     gas, mem_label="stage-3 staged forward: gathered "
                     "weights + vjp residuals (fwd->bwd stash) — the "
-                    "footprint stage3_prefetch_budget bounds")
+                    "footprint stage3_prefetch_budget bounds",
+                    contract={
+                        # the staged forward gathers each partitioned
+                        # leaf EXACTLY once, on the s8 wire (fp32 gathers
+                        # are the tiny per-block scales, < 64 elements in
+                        # the plan's block geometry)
+                        "host_transfer_free": True,
+                        "wire_dtype": "s8",
+                        "wire_min_elements": 64,
+                        "expect_op_counts":
+                            [("all-gather", "s8", n_gathered)]
+                            if n_gathered else None,
+                    })
                 loss, self._pending_s3_stash = \
                     self._jit_s3_fwd(self.state, dev_batch)
                 self._pending_loss = loss
@@ -3052,7 +3193,8 @@ class DeepSpeedEngine:
             self._register_mfu_jit(
                 "micro_step", self._jit_micro, (self.state, dev_batch),
                 gas, mem_label="micro step: donated-in-place train state "
-                "+ staged loss + activations")
+                "+ staged loss + activations",
+                contract=self._micro_program_contract())
             if self._offload:
                 new_state, loss, grads = self._jit_micro(self.state,
                                                          dev_batch)
@@ -3089,9 +3231,18 @@ class DeepSpeedEngine:
             import jax
 
             gas = self.gradient_accumulation_steps()
-            self._register_mfu_jit("s3_bwd", self._jit_s3_bwd,
-                                   (self.state, self._pending_s3_stash),
-                                   gas)
+            self._register_mfu_jit(
+                "s3_bwd", self._jit_s3_bwd,
+                (self.state, self._pending_s3_stash), gas,
+                contract={
+                    # the backward reuses the stash residuals: ZERO
+                    # all-gathers (one would be a remat refetch), and the
+                    # stash (argnum 1) is donated — freed at wgrad, not
+                    # held to the end of the batch
+                    "host_transfer_free": True,
+                    "forbid_collectives": ("all-gather",),
+                    "donates_argnums": (1,),
+                })
             with jax.set_mesh(self.mesh):
                 self.state = self._jit_s3_bwd(self.state,
                                               self._pending_s3_stash)
@@ -3272,8 +3423,11 @@ class DeepSpeedEngine:
         _t0 = tr.begin() if tr is not None else 0.0
         with jax.set_mesh(self.mesh):
             apply_fn = self._apply_callable()
+            apply_name, apply_contract = self._apply_program_spec()
             self._register_mfu_jit("apply_step", apply_fn,
-                                   (self.state, jnp.float32(lr)))
+                                   (self.state, jnp.float32(lr)),
+                                   program_name=apply_name,
+                                   contract=apply_contract)
             new_state, metrics = apply_fn(self.state, jnp.float32(lr))
         self.state = new_state
         self.global_steps += 1
@@ -3375,8 +3529,11 @@ class DeepSpeedEngine:
                 for i in range(gas):
                     dev_micro = self._shard_batch(_micro_at(batch, i))
                     self._note_mfu_workload(dev_micro, micros_in_batch=gas)
-                    self._register_mfu_jit("micro_offload", self._jit_micro,
-                                           (self.state, dev_micro), gas)
+                    self._register_mfu_jit(
+                        "micro_offload", self._jit_micro,
+                        (self.state, dev_micro), gas,
+                        contract={"host_transfer_free": True,
+                                  "donates_argnums": (0,)})
                     self.state, loss, grads = self._jit_micro(self.state,
                                                               dev_micro)
                     fetch = self._start_grad_fetch(grads)
@@ -3407,11 +3564,13 @@ class DeepSpeedEngine:
         _t0 = tr.begin() if tr is not None else 0.0
         with jax.set_mesh(self.mesh):
             fused_fn = self._fused_callable()
+            fused_name, fused_contract = self._fused_program_spec()
             self._register_mfu_jit(
                 "fused_train_step", fused_fn,
                 (self.state, dev, jnp.float32(lr)),
                 mem_label="fused train step: donated-in-place state + "
-                "step metrics + per-micro activations")
+                "step metrics + per-micro activations",
+                program_name=fused_name, contract=fused_contract)
             new_state, metrics = fused_fn(self.state, dev, jnp.float32(lr))
         self.state = new_state
         self.global_steps += 1
@@ -3469,7 +3628,11 @@ class DeepSpeedEngine:
         with jax.set_mesh(self.mesh):
             # _live_state: a validation loss mid-accumulation must read the
             # staged (alive) state, not the donated committed one
-            loss = self._jit_eval(self._live_state, self._shard_batch(batch))
+            dev_b = self._shard_batch(batch)
+            self._register_program("eval_loss", self._jit_eval,
+                                   (self._live_state, dev_b),
+                                   contract={"host_transfer_free": True})
+            loss = self._jit_eval(self._live_state, dev_b)
         if self._watchdog is not None:
             # a long validation loop between optimizer steps is progress,
             # not a stalled step

@@ -54,29 +54,33 @@ class StageState(NamedTuple):
 
 
 class _MfuJitProxy:
-    """Transparent stage-jit wrapper for the MFU and measured-memory
-    ledgers: on FIRST dispatch it captures a ShapeDtypeStruct tree of the
-    real args and registers a lazy lower+compile with telemetry/mfu.py
-    (and, when the engine arms memory accounting, the same name with
-    runtime/memory_accounting.py — the two share ONE compiled object per
-    jit), then calls through.  Only installed when a ledger is armed —
-    the disarmed hot path runs the bare jit.  Attribute access
-    (``.lower`` for the HLO contract tests) passes through to the
+    """Transparent stage-jit wrapper for the compiled-program registry
+    and the MFU/measured-memory ledgers: on FIRST dispatch it captures a
+    ShapeDtypeStruct tree of the real args and registers a lazy
+    lower+compile with telemetry/programs.py (always — the registry is
+    the seam tools/graftlint/program_lint.py reads, and registration is
+    a shape capture + dict insert, no compile) plus telemetry/mfu.py and
+    runtime/memory_accounting.py when those ledgers are armed (the two
+    share ONE compiled object per jit), then calls through.  Attribute
+    access (``.lower`` for the HLO contract tests) passes through to the
     wrapped jit."""
 
     # __weakref__: jax.eval_shape / linear_util cache weakref their
     # callables (the stash-size estimate abstract-evals fwd_stash
     # through this proxy)
     __slots__ = ("fn", "name", "mfu", "mem", "mesh", "calls",
-                 "_registered", "__weakref__")
+                 "programs", "contract", "_registered", "__weakref__")
 
-    def __init__(self, fn, name, mfu, mesh, calls, mem=None):
+    def __init__(self, fn, name, mfu, mesh, calls, mem=None,
+                 programs=None, contract=None):
         self.fn = fn
         self.name = name
         self.mfu = mfu
         self.mem = mem
         self.mesh = mesh
         self.calls = calls
+        self.programs = programs
+        self.contract = contract
         self._registered = False
 
     def __call__(self, *args):
@@ -92,8 +96,12 @@ class _MfuJitProxy:
             if not any(isinstance(l, jax.core.Tracer)
                        for l in jax.tree_util.tree_leaves(args)):
                 self._registered = True
-                from deepspeed_tpu.telemetry import register_by_shape
+                from deepspeed_tpu.telemetry import (register_by_shape,
+                                                     register_program)
 
+                register_program(self.programs, self.name, self.fn, args,
+                                 mesh=self.mesh, contract=self.contract,
+                                 calls_per_step=self.calls)
                 register_by_shape(self.mfu, self.name, self.fn, args,
                                   mesh=self.mesh,
                                   calls_per_step=self.calls)
@@ -124,6 +132,10 @@ class PipelineEngine(DeepSpeedEngine):
         super().__init__(*args, **kwargs)
         assert isinstance(self.module, PipelineModule), \
             "PipelineEngine requires a PipelineModule model"
+        # own program-registry namespace: pipe jits are per-(chunk, kind),
+        # not the base engine's micro/apply programs (nothing registered
+        # yet — base-engine registration happens at first dispatch)
+        self._programs.engine = "pipe"
         if self.zero_optimization_stage() > 2:
             # stage-3 parameter partitioning (and its scheduled gather
             # plan) lives in the base engine: here each stage's params
@@ -690,22 +702,52 @@ class PipelineEngine(DeepSpeedEngine):
                     donate_argnums=(0, 1))
             tel = self._telemetry
             mem = self._memacct
-            if (tel is not None and tel.mfu is not None) \
-                    or mem is not None:
-                # per-compute-jit FLOPs/bytes for the MFU + memory
-                # ledgers: fwd/bwd kinds run once per micro per chunk,
-                # the reductions/apply once per optimizer step
-                per_micro = {"fwd", "fwd_stash", "bwd_last", "bwd_mid",
-                             "bwd_dgrad", "bwd_wgrad", "bwd_dgrad_stash",
-                             "bwd_wgrad_stash"}
-                mfu = tel.mfu if tel is not None else None
-                jits = {
-                    k: _MfuJitProxy(v, f"chunk{s}:{k}", mfu, submesh,
-                                    gas if k in per_micro else 1.0,
-                                    mem=mem)
-                    if (v is not None and k != "mesh") else v
-                    for k, v in jits.items()}
+            # every compute jit is proxied: the program registry is
+            # always on (registration is a first-dispatch shape capture,
+            # no compile — the disarmed step stays bit-identical with
+            # zero extra compiles); MFU/memory ledgers ride the same
+            # proxy only when armed.  fwd/bwd kinds run once per micro
+            # per chunk, the reductions/apply once per optimizer step.
+            per_micro = {"fwd", "fwd_stash", "bwd_last", "bwd_mid",
+                         "bwd_dgrad", "bwd_wgrad", "bwd_dgrad_stash",
+                         "bwd_wgrad_stash"}
+            mfu = tel.mfu if tel is not None else None
+            n_accum = len(jax.tree_util.tree_leaves(zero_sh))
+            jits = {
+                k: _MfuJitProxy(v, f"chunk{s}:{k}", mfu, submesh,
+                                gas if k in per_micro else 1.0,
+                                mem=mem, programs=self._programs,
+                                contract=self._stage_jit_contract(
+                                    k, is_last, n_accum))
+                if (v is not None and k != "mesh") else v
+                for k, v in jits.items()}
             self._stage_jits.append(jits)
+
+    def _stage_jit_contract(self, kind, is_last, n_accum):
+        """The HLO contract one stage-jit kind declares to the program
+        registry (telemetry/programs.py): every compute jit is pure
+        device work; a non-last forward's boundary activation leaves the
+        stage in the compute dtype (an f32 boundary would double the p2p
+        bytes pipeline_report() budgets per edge); backward kinds donate
+        the grad accumulator; the zb-stash wgrad additionally donates the
+        residual stash and writes every new-accum output into donated
+        memory (no copy on the dgrad->wgrad handoff)."""
+        import numpy as np
+
+        contract = {"host_transfer_free": True}
+        if kind == "fwd" and not is_last:
+            short = {"float32": "f32", "bfloat16": "bf16",
+                     "float16": "f16", "float64": "f64"}
+            name = np.dtype(self.compute_dtype).name
+            contract["boundary_dtypes"] = [short.get(name, name)]
+        if kind in ("bwd_last", "bwd_mid", "bwd_wgrad"):
+            contract["donates_argnums"] = (1,)
+        if kind == "apply_step":
+            contract["donates_argnums"] = (0,)
+        if kind == "bwd_wgrad_stash":
+            contract["donates_argnums"] = (0, 1)
+            contract["outputs_aliased"] = n_accum
+        return contract
 
     def _stash_bytes_estimate(self, sample_micro):
         """Per-chunk, per-micro stash bytes (the vjp-residual leaves of one

@@ -392,6 +392,14 @@ class InferenceEngine:
             else ReliabilityConfig(**(reliability or {}))
         self.reliability = Reliability(self, rel_cfg)
         self._arm_telemetry(telemetry)
+        # compiled-program registry (telemetry/programs.py): ALWAYS on —
+        # every serving jit registers its shape capture + HLO contract at
+        # first dispatch for tools/graftlint/program_lint.py; the pool
+        # registers its COW-split program through the same seam
+        from deepspeed_tpu.telemetry import ProgramRegistry
+
+        self._programs = ProgramRegistry("serving")
+        self.pool.programs = self._programs
         S = self.max_slots
         self._tables = np.full((S, self.W), TRASH_BLOCK, np.int32)
         self._pos = np.zeros(S, np.int32)
@@ -412,6 +420,33 @@ class InferenceEngine:
             self._spec = _make_spec_verify(
                 cfg, self.spec_k, self.W, self.bs, self.pool.quantized,
                 mesh, axis_name)
+
+    @property
+    def program_registry(self):
+        """The engine's compiled-program registry (always armed): every
+        serving jit dispatched so far, with its declarative HLO contract.
+        Read by ``python -m tools.graftlint --programs``."""
+        return self._programs
+
+    def _pool_contract(self, **extra):
+        """The contract every pool-threading serving jit shares: pure
+        device work, ZERO collective bytes under batch-axis sharding
+        (comm_accounting.serving_decode_collectives' placement-semantics
+        claim), and the paged KV pool (argnums 1..n_pool) donated —
+        steady-state serving is allocation-free on the pool."""
+        contract = {
+            "host_transfer_free": True,
+            "collective_free": True,
+            "donates_argnums": tuple(range(1, 1 + self.n_pool_tensors())),
+        }
+        contract.update(extra)
+        return contract
+
+    def _register_serving_program(self, name, jit_fn, args, **extra):
+        from deepspeed_tpu.telemetry import register_program
+
+        register_program(self._programs, name, jit_fn, args,
+                         mesh=None, contract=self._pool_contract(**extra))
 
     def _arm_prefix_cache(self, requested, quantize_kv_requested):
         """COW shared-prefix caching arms only where its bookkeeping is
@@ -1293,15 +1328,22 @@ class InferenceEngine:
             self.temperature, self.top_k, self.top_p, self.mesh,
             self.axis_name)
         rows, nv = self._prefill_args(req, n)
+        pf_name = f"prefill_chunk{bucket}" + ("_final" if final else "")
+        pf_args = (self.params, *self.pool.tensors.arrays, rows,
+                   tok_pad, np.int32(start), nv, np.int32(req.seed))
+        # bucketed prefill programs at the same schedule slot must post
+        # identical collective sequences (uniform_group) — a divergence
+        # between buckets would deadlock a multi-host SPMD dispatch
+        self._register_serving_program(
+            pf_name, fn, pf_args,
+            uniform_group="serving:prefill_final" if final
+            else "serving:prefill")
         if self.telemetry is not None:
             # every bucketed prefill jit joins the MFU + memory ledgers
             # (capture-by-shape, no-op after the first registration)
             from deepspeed_tpu.runtime import memory_accounting as mem_acc
             from deepspeed_tpu.telemetry import register_by_shape
 
-            pf_name = f"prefill_chunk{bucket}" + ("_final" if final else "")
-            pf_args = (self.params, *self.pool.tensors.arrays, rows,
-                       tok_pad, np.int32(start), nv, np.int32(req.seed))
             register_by_shape(self.telemetry.mfu, pf_name, fn, pf_args)
             mem_acc.register_by_shape(self._memacct, pf_name, fn, pf_args)
         out = fn(self.params, *self.pool.tensors.arrays, rows, tok_pad,
@@ -1390,13 +1432,15 @@ class InferenceEngine:
             self._drafts[slot] = drafts
             req.work_done += n
         tel = self.telemetry
+        spec_args = (self.params, *self.pool.tensors.arrays,
+                     self._tables, self._pos, toks_in, nvalid,
+                     self._active, self._poison)
+        self._register_serving_program("spec_verify", self._spec,
+                                       spec_args)
         if tel is not None:
             from deepspeed_tpu.runtime import memory_accounting as mem_acc
             from deepspeed_tpu.telemetry import register_by_shape
 
-            spec_args = (self.params, *self.pool.tensors.arrays,
-                         self._tables, self._pos, toks_in, nvalid,
-                         self._active, self._poison)
             register_by_shape(tel.mfu, "spec_verify", self._spec,
                               spec_args)
             mem_acc.register_by_shape(
@@ -1467,16 +1511,18 @@ class InferenceEngine:
             self._tables[slot] = self.pool.table_row(req.rid, self.W)
             req.work_done += 1
         tel = self.telemetry
+        # capture-by-shape BEFORE dispatch (the pool is donated by it);
+        # the lower+compile runs lazily at report/lint time, outside any
+        # recompile-guard window
+        decode_args = (self.params, *self.pool.tensors.arrays,
+                       self._tables, self._pos, self._tok,
+                       self._active, self._seeds, self._poison)
+        self._register_serving_program("decode_step", self._decode,
+                                       decode_args)
         if tel is not None:
-            # capture-by-shape BEFORE dispatch (the pool is donated by
-            # it); the lower+compile runs lazily at report time, outside
-            # any recompile-guard window
             from deepspeed_tpu.runtime import memory_accounting as mem_acc
             from deepspeed_tpu.telemetry import register_by_shape
 
-            decode_args = (self.params, *self.pool.tensors.arrays,
-                           self._tables, self._pos, self._tok,
-                           self._active, self._seeds, self._poison)
             register_by_shape(tel.mfu, "decode_step", self._decode,
                               decode_args)
             mem_acc.register_by_shape(

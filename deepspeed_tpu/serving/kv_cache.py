@@ -133,6 +133,11 @@ class PagedKVPool:
         self.blocks_per_shard = self.num_blocks // self.shards
         self.dtype = dtype or cfg.dtype
         self.quantized = self._arm_quantized_kv(quantize_kv)
+        # compiled-program registry seam (telemetry/programs.py): the
+        # owning InferenceEngine installs its registry here so the
+        # COW-split copy joins the same program view the serving jits
+        # report to; None (standalone pools) skips registration
+        self.programs = None
 
         L, H, D = cfg.n_layer, cfg.n_head, cfg.head_dim
         bs = self.block_size
@@ -396,6 +401,18 @@ class PagedKVPool:
         the donated dispatch path sees identically-placed arrays."""
         base = shard * self.blocks_per_shard
         g_src, g_dst = np.int32(base + src), np.int32(base + dst)
+        if self.programs is not None and not self.programs.has("cow_copy"):
+            from deepspeed_tpu.telemetry import register_program
+
+            # first dispatch (warm_cow's trash self-copy in production):
+            # the COW split is pure device work, collective-free, and
+            # donates the pool — block churn never allocates or syncs
+            register_program(
+                self.programs, "cow_copy", _cow_copy_rows,
+                (self.tensors.arrays, g_src, g_dst),
+                contract={"host_transfer_free": True,
+                          "collective_free": True,
+                          "donates_argnums": (0,)})
         arrs = _cow_copy_rows(self.tensors.arrays, g_src, g_dst)
         if self.mesh is not None and self.shards > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P

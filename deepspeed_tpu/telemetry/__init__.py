@@ -26,6 +26,8 @@ from deepspeed_tpu.telemetry.mfu import (MfuAccounting,
                                          normalize_cost_analysis,
                                          peak_flops_per_device,
                                          register_by_shape)
+from deepspeed_tpu.telemetry.programs import (ProgramRegistry,
+                                              register_program)
 from deepspeed_tpu.telemetry.trace import (Tracer, lane_utilization)
 
 __all__ = [
@@ -34,6 +36,7 @@ __all__ = [
     "nearest_rank",
     "MfuAccounting", "model_flops_per_step", "normalize_cost_analysis",
     "peak_flops_per_device", "register_by_shape",
+    "ProgramRegistry", "register_program",
 ]
 
 

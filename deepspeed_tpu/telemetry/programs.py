@@ -1,0 +1,229 @@
+"""Compiled-program registry: every jit an engine builds, with contracts.
+
+This generalizes :mod:`.mfu`'s capture-by-shape registration from "the
+jits the FLOP ledger cares about" to "every jit the engine dispatches",
+and attaches **declarative contract metadata** to each entry — the
+performance/correctness claims the program must keep at the HLO level:
+
+- ``host_transfer_free``: no infeed/outfeed/host callback may survive
+  compilation (a stray debug print would stall every dispatch);
+- ``collective_free``: the program moves ZERO cross-device bytes
+  (0/1 Adam local rounds, batch-sharded serving decode);
+- ``wire_dtype``: the collective payload dtype(s) the program declares
+  (``"s8"``, ``("u8", "s8")``); any f32/bf16 collective at or above
+  ``wire_min_elements`` in such a program means the partitioner
+  silently re-widened the wire (the EQuARX failure class);
+- ``donates`` / ``donates_argnums``: entry parameters that MUST appear
+  in the ``input_output_alias`` / ``buffer_donor`` header tables — a
+  declared-donated input missing from both pays a silent copy per call
+  and re-arms the allocator at every dispatch; ``donation_min_elements``
+  exempts sub-threshold leaves (XLA declines to alias tiny pass-through
+  buffers — an rng key threaded through a ``lax.cond`` — and the copy
+  cost is nil);
+- ``comm_budget_bytes`` (+ ``comm_budget_key``, ``comm_small_op_cutoff``):
+  analytic byte ceiling for the program's total collective payload;
+- ``boundary_dtypes``: exact entry-output dtype list (pipeline boundary
+  activations must leave a bf16 stage in bf16);
+- ``forbid_collectives`` / ``expect_op_counts``: op kinds that must not
+  appear (a backward that regathers weights) / exact (op, dtype, count)
+  expectations (one s8 gather per partitioned stage-3 leaf);
+- ``outputs_aliased``: at least this many outputs write into donated
+  memory (grad-accumulator handoffs);
+- ``uniform_group``: programs sharing a group name are executed at the
+  same schedule slot by different callers and must post an IDENTICAL
+  collective sequence — a divergence is a static SPMD deadlock.
+
+Contract values may be zero-arg callables: they resolve lazily when the
+lint pass reads them (analytic comm budgets depend on
+``comm_volume_report()`` state that settles after warmup).
+
+Registration is free on the hot path (a ShapeDtypeStruct capture and a
+dict insert, once per program); ``lower().compile()`` runs lazily when
+``tools/graftlint/program_lint.py`` walks the registry — never at
+dispatch time, never inside a recompile-guard window.  This registry is
+the shared program view ROADMAP item 5's unified plan compiler consumes.
+"""
+import threading
+
+from deepspeed_tpu.telemetry.mfu import shape_structs
+
+# every key a contract dict may carry — program_lint validates against
+# this so a typo'd declaration fails loudly instead of never checking
+CONTRACT_KEYS = frozenset({
+    "host_transfer_free", "collective_free",
+    "wire_dtype", "wire_min_elements",
+    "donates", "donates_argnums", "donation_min_elements",
+    "comm_budget_bytes", "comm_budget_key", "comm_small_op_cutoff",
+    "boundary_dtypes", "forbid_collectives", "expect_op_counts",
+    "outputs_aliased", "uniform_group",
+})
+
+
+class ProgramEntry:
+    """One registered program: a lazy lower/compile closure + contract."""
+
+    __slots__ = ("name", "make_lowered", "contract", "calls_per_step",
+                 "_hlo", "_error", "_kept")
+
+    def __init__(self, name, make_lowered, contract, calls_per_step):
+        self.name = name
+        self.make_lowered = make_lowered
+        self.contract = dict(contract or {})
+        self.calls_per_step = float(calls_per_step)
+        self._hlo = None
+        self._error = None
+        self._kept = None
+
+    def hlo(self):
+        """Optimized HLO text, compiled lazily once and cached.  Raises
+        what the lowering raised (also cached, so a broken program costs
+        one compile attempt, not one per contract)."""
+        if self._error is not None:
+            raise self._error
+        if self._hlo is None:
+            try:
+                lowered = self.make_lowered()
+                self._kept = self._kept_var_idx(lowered)
+                self._hlo = lowered.compile().as_text()
+            except Exception as e:  # lint: allow-broad-except — cache
+                # the failure whatever it was; the lint pass reports it
+                self._error = e
+                raise
+        return self._hlo
+
+    @property
+    def kept_var_idx(self):
+        """Sorted FLAT arg indices the lowering kept as entry parameters
+        (jit prunes unused args by default, shifting HLO parameter
+        numbers against flat indices), or None when unknown.  Populated
+        by :meth:`hlo`; the lint's donation scan translates declared
+        flat ``donates`` indices through this before reading the alias
+        tables."""
+        return self._kept
+
+    @staticmethod
+    def _kept_var_idx(lowered):
+        try:
+            kept = lowered._lowering.compile_args.get("kept_var_idx")
+            return sorted(kept) if kept is not None else None
+        except Exception:  # internal API — absence degrades gracefully
+            return None
+
+
+class ProgramRegistry:
+    """Per-engine registry of every jit the engine builds."""
+
+    def __init__(self, engine="engine"):
+        self.engine = str(engine)
+        self._entries = {}
+        self._lock = threading.Lock()
+
+    def has(self, name):
+        return name in self._entries
+
+    def names(self):
+        return sorted(self._entries)
+
+    def get(self, name):
+        return self._entries.get(name)
+
+    def entries(self):
+        """Entries in sorted-name order (stable lint reports)."""
+        return [self._entries[n] for n in sorted(self._entries)]
+
+    def register(self, name, make_lowered, contract=None,
+                 calls_per_step=1.0):
+        bad = set(contract or ()) - CONTRACT_KEYS
+        if bad:
+            raise ValueError(
+                f"unknown contract key(s) {sorted(bad)} for program "
+                f"{name!r}; known: {sorted(CONTRACT_KEYS)}")
+        with self._lock:
+            if name not in self._entries:
+                self._entries[name] = ProgramEntry(
+                    name, make_lowered, contract, calls_per_step)
+
+    def declare(self, name, **contract):
+        """Merge contract keys into an already-registered entry (for
+        claims only known after registration)."""
+        bad = set(contract) - CONTRACT_KEYS
+        if bad:
+            raise ValueError(f"unknown contract key(s) {sorted(bad)}")
+        entry = self._entries[name]
+        entry.contract.update(contract)
+
+    def summary(self):
+        """JSON-able view: {name: {contract (callables resolved),
+        calls_per_step}} — what ``--programs --json`` ships."""
+        out = {}
+        for entry in self.entries():
+            out[entry.name] = {
+                "contract": {k: resolve_contract_value(v)
+                             for k, v in sorted(entry.contract.items())},
+                "calls_per_step": entry.calls_per_step,
+            }
+        return out
+
+
+def resolve_contract_value(value):
+    """Contract values may be zero-arg callables (lazy analytic budgets);
+    resolve to something JSON-able."""
+    if callable(value):
+        try:
+            value = value()
+        except Exception as e:  # lint: allow-broad-except — a budget
+            # that cannot resolve is itself a reportable fact
+            return f"<unresolvable: {type(e).__name__}: {e}>"
+    if isinstance(value, (tuple, set, frozenset)):
+        return list(value)
+    if isinstance(value, range):
+        return list(value)
+    return value
+
+
+def _leaf_offsets(args):
+    """Flat entry-parameter index offset of each positional arg (a jit
+    with no static args flattens its arguments in order)."""
+    import jax
+
+    offsets, total = [], 0
+    for a in args:
+        offsets.append(total)
+        total += len(jax.tree_util.tree_leaves(a))
+    return offsets, total
+
+
+def register_program(programs, name, jit_fn, args, mesh=None,
+                     contract=None, calls_per_step=1.0):
+    """THE capture-by-shape program registration: take a
+    ``jax.ShapeDtypeStruct`` tree of the REAL dispatch args NOW (donated
+    buffers still alive, shardings preserved) and register a lazy
+    ``lower().compile()`` closure plus the program's contract.  A
+    ``donates_argnums`` contract key is expanded here — while the real
+    args are in hand — into the flat ``donates`` parameter indices the
+    HLO header tables speak.  No-op when ``programs``/``jit_fn`` is None
+    or ``name`` is already registered."""
+    if programs is None or jit_fn is None or programs.has(name):
+        return
+    import jax
+
+    contract = dict(contract or {})
+    if "donates_argnums" in contract:
+        offsets, total = _leaf_offsets(args)
+        donated = []
+        for argnum in contract.pop("donates_argnums"):
+            lo = offsets[argnum]
+            hi = offsets[argnum + 1] if argnum + 1 < len(offsets) else total
+            donated.extend(range(lo, hi))
+        existing = list(contract.get("donates", ()))
+        contract["donates"] = sorted(set(existing) | set(donated))
+
+    structs = shape_structs(args)
+
+    def make_lowered():
+        if mesh is None:
+            return jit_fn.lower(*structs)
+        with jax.set_mesh(mesh):
+            return jit_fn.lower(*structs)
+
+    programs.register(name, make_lowered, contract, calls_per_step)

@@ -155,3 +155,19 @@ def test_wall_budget_exhaustion_emits_structured_json(tmp_path,
     assert payload["attempts"][0]["last_phase"] == "spawn"
     saved = b._load_cache(cache)
     assert saved["__env__"]["wall_killed"] is True
+
+
+def test_telemetry_paths_ship_program_lint_artifact(tmp_path):
+    """ISSUE 19 satellite: every telemetry round reserves a program-lint
+    JSON artifact path next to the metrics digest and trace — the
+    contract findings land beside the perf evidence they explain."""
+    b = _bench()
+    args = argparse.Namespace(telemetry_dir=str(tmp_path), model="m",
+                              batch=4, seq=256)
+    paths = b._telemetry_paths(args)
+    assert set(paths) == {"metrics", "trace", "program_lint"}
+    assert paths["program_lint"].endswith(".json")
+    assert os.path.dirname(paths["program_lint"]) == str(tmp_path)
+    # same stamp family as the digest: retries never collide
+    again = b._telemetry_paths(args)
+    assert again["program_lint"] != paths["program_lint"]

@@ -227,6 +227,35 @@ def test_cli_baseline_update_roundtrip(tmp_path):
                 "--strict-stale").returncode == 1
 
 
+def test_cli_strict_stale_composes_with_baseline_update(tmp_path):
+    """ISSUE 19 satellite bugfix: --strict-stale --baseline-update must
+    BOTH prune the stale entries AND exit 1 in the same run — before,
+    --baseline-update returned 0 unconditionally, so a CI job asking to
+    prune-and-flag saw the prune but never the flag (exit code and
+    prune disagreed)."""
+    tmp = str(tmp_path)
+    bad = _write(tmp, "bad.py", BAD_FILE)
+    baseline = os.path.join(tmp, "b.json")
+    assert _cli(bad, "--baseline", baseline,
+                "--baseline-update").returncode == 0
+    assert len(load_baseline(baseline)["entries"]) == 1
+
+    _write(tmp, "bad.py", GOOD_FILE)   # the finding is fixed -> stale
+    # plain --strict-stale: flags the drift, does NOT prune
+    assert _cli(bad, "--baseline", baseline,
+                "--strict-stale").returncode == 1
+    assert len(load_baseline(baseline)["entries"]) == 1
+    # composed: prunes AND still exits 1 — one CI invocation sees both
+    proc = _cli(bad, "--baseline", baseline,
+                "--strict-stale", "--baseline-update")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "1 stale pruned" in proc.stdout
+    assert load_baseline(baseline)["entries"] == []
+    # pruned baseline, nothing stale left: the same invocation is clean
+    assert _cli(bad, "--baseline", baseline,
+                "--strict-stale", "--baseline-update").returncode == 0
+
+
 def test_nonexistent_root_raises_not_empty_scan(tmp_path):
     """A missing root must error, not silently scan nothing — an empty
     scan feeding --baseline-update would wipe the baseline."""
@@ -818,6 +847,75 @@ def test_rank_branch_collective_quiet_on_fixes():
     assert lint(SPMD_GOOD, rules=["rank-branch-collective"]) == []
     # process_count is uniform across ranks: not a divergence hazard
     assert lint(SPMD_GOOD_UNIFORM_GUARD,
+                rules=["rank-branch-collective"]) == []
+
+
+SPMD_BAD_QUANT_WIRE = """
+def exchange(grads, jax, cc, mesh):
+    if jax.lax.axis_index("data") == 0:
+        grads = cc.quantized_all_reduce(grads, "data", bits=1)
+    g = cc.quantized_all_gather(grads, mesh)
+    if jax.process_index() == 0:
+        g = cc.quantized_reduce_scatter(g, "data")
+    return g
+"""
+
+SPMD_GOOD_QUANT_WIRE = """
+def exchange(grads, jax, cc, mesh):
+    grads = cc.quantized_all_reduce(grads, "data", bits=1)
+    return cc.quantized_all_gather(grads, mesh)
+"""
+
+SPMD_BAD_TRANSPORT_BARRIER = """
+def monitor(self, jax, wall_step):
+    if jax.process_index() == 0:
+        self.transport.heartbeat_tick(wall_step)
+        return self.transport.vote_dead((), wall_step)
+    return ()
+"""
+
+SPMD_GOOD_TRANSPORT_BARRIER = """
+def monitor(self, jax, wall_step):
+    self.transport.heartbeat_tick(wall_step)
+    dead = self.transport.vote_dead((), wall_step)
+    if jax.process_index() == 0:
+        log_dead(dead)
+    return dead
+"""
+
+SPMD_GOOD_SUBMIT_NOT_A_BARRIER = """
+def admit(self, jax, prompt):
+    if jax.process_index() == 0:
+        return self.engine.submit(prompt, max_new_tokens=8)
+"""
+
+
+def test_rank_branch_quantized_collectives_fire():
+    """ISSUE 19 satellite: the PR-18 quantized wire collectives are
+    rank-gated deadlocks like their dense counterparts — all three
+    custom ops under a rank branch fire; unconditional use is quiet."""
+    got = lint(SPMD_BAD_QUANT_WIRE, rules=["rank-branch-collective"])
+    assert rule_names(got) == ["rank-branch-collective"] * 2
+    assert "quantized_all_reduce" in got[0].message
+    assert "quantized_reduce_scatter" in got[1].message
+    assert lint(SPMD_GOOD_QUANT_WIRE,
+                rules=["rank-branch-collective"]) == []
+
+
+def test_rank_branch_transport_barriers_fire():
+    """ISSUE 19 satellite: transport-level quorum barriers
+    (heartbeat_tick / vote_dead) wedge exactly like device collectives
+    when only rank 0 posts them; running the round on every peer and
+    rank-gating the LOGGING is the quiet twin.  serving's submit() is
+    an unrelated name and must never fire."""
+    got = lint(SPMD_BAD_TRANSPORT_BARRIER,
+               rules=["rank-branch-collective"])
+    assert rule_names(got) == ["rank-branch-collective"] * 2
+    assert "heartbeat_tick" in got[0].message
+    assert "vote_dead" in got[1].message
+    assert lint(SPMD_GOOD_TRANSPORT_BARRIER,
+                rules=["rank-branch-collective"]) == []
+    assert lint(SPMD_GOOD_SUBMIT_NOT_A_BARRIER,
                 rules=["rank-branch-collective"]) == []
 
 
@@ -1501,6 +1599,45 @@ def test_disarmed_discipline_cache_and_spec_arming(bad, good):
                            rules=["disarmed-discipline"])) \
         == ["disarmed-discipline"]
     assert lint(good, path, rules=["disarmed-discipline"]) == []
+
+
+DISARM_QUANT_KV_BAD = """
+class PagedKVPool:
+    def _arm_quantized_kv(self, requested):
+        if not requested:
+            return False
+        elem = np.dtype(self.dtype).itemsize
+        if self.cfg.head_dim * (elem - 1) <= 4:
+            return False
+        return True
+"""
+
+DISARM_QUANT_KV_GOOD = """
+class PagedKVPool:
+    def _arm_quantized_kv(self, requested):
+        if not requested:
+            return False
+        elem = np.dtype(self.dtype).itemsize
+        if self.cfg.head_dim * (elem - 1) <= 4:
+            logger.warning("PagedKVPool: int8 KV quantization DISARMED "
+                           "- the per-row f32 scale outweighs the "
+                           "element savings; int8 would GROW the pool")
+            return False
+        return True
+"""
+
+
+def test_disarmed_discipline_covers_arm_quantized_kv():
+    """ISSUE 19 satellite: the KV pool's int8 arming decision follows
+    the armed-or-warns discipline — silently serving full-precision KV
+    after int8 was REQUESTED (off-profitability head_dim) fires; a
+    DISARMED warn naming the blocker is quiet."""
+    path = "deepspeed_tpu/serving/kv_cache.py"
+    got = lint(DISARM_QUANT_KV_BAD, path, rules=["disarmed-discipline"])
+    assert rule_names(got) == ["disarmed-discipline"]
+    assert "_arm_quantized_kv" in got[0].message
+    assert lint(DISARM_QUANT_KV_GOOD, path,
+                rules=["disarmed-discipline"]) == []
 
 
 # ---------------------------------------------------------------------------

@@ -160,10 +160,10 @@ def test_parity_eos_early_stop(toy):
 
 def test_zero_recompiles_after_warmup(toy):
     """>= 20 decode steps of join/leave/complete churn compile NOTHING
-    new after warmup, and the decode program is host-transfer-free with
-    the KV pool donated (HLO contracts)."""
-    from tools.graftlint import hlo_contracts as hc
-
+    new after warmup.  (The decode program's host-transfer-free /
+    pool-donation HLO contracts are declared on decode_step in the
+    program registry and checked by the --programs autopilot,
+    tests/unit/test_program_lint.py.)"""
     model, params, ref = toy
     eng = _engine(model, params)
     eng.warmup()
@@ -182,11 +182,6 @@ def test_zero_recompiles_after_warmup(toy):
     for rid, p, m in zip(rids, prompts, maxnew):
         np.testing.assert_array_equal(eng.results[rid]["tokens"],
                                       ref(p, m))
-    hlo = eng.decode_hlo()
-    hc.assert_no_host_transfers(hlo, "serving decode step")
-    nleaves = len(jax.tree_util.tree_leaves(params))
-    pool_params = range(nleaves, nleaves + eng.n_pool_tensors())
-    hc.assert_donates(hlo, pool_params, "serving decode step")
 
 
 def test_warmup_covers_multichunk_prompts_on_small_capacity(toy):

@@ -75,20 +75,6 @@ def _train(engine, steps=10, hidden=HIDDEN, seed=0):
     return losses
 
 
-def _fwd_bwd_hlos(engine, hidden=HIDDEN):
-    """(fwd_hlo, bwd_hlo, stash, n_stash) of the staged stage-3 jits."""
-    rng = np.random.default_rng(0)
-    batch = {"x": rng.standard_normal((8, hidden)).astype(np.float32),
-             "y": rng.integers(0, 4, (8,)).astype(np.int32)}
-    dev = engine._shard_batch(batch)
-    with jax.set_mesh(engine.mesh):
-        _, stash = engine._jit_s3_fwd(engine.state, dev)
-        fwd = engine._jit_s3_fwd.lower(engine.state, dev).compile().as_text()
-        bwd = engine._jit_s3_bwd.lower(engine.state, stash) \
-            .compile().as_text()
-    return fwd, bwd, stash, len(jax.tree_util.tree_leaves(stash))
-
-
 # ---------------------------------------------------------------------------
 # arming, plan, and the DISARMED discipline
 # ---------------------------------------------------------------------------
@@ -255,40 +241,10 @@ def test_stage3_micro_jit_gather_wire_is_s8_within_budget(eight_devices):
     assert measured <= int(budget * dp / (dp - 1)) + 1, (measured, budget)
 
 
-def test_stage3_no_backward_refetch_and_stash_donated(eight_devices):
-    """The staged split: the forward jit carries ALL the s8 gathers; the
-    backward jit contains ZERO all-gathers (gathered weights persist as
-    vjp residuals) and DONATES the stash — every residual leaf is
-    output-aliased or a buffer donor in the HLO header, and the runtime
-    leaves are consumed at wgrad (freed in place, not held to peak)."""
-    e = _engine()
-    _train(e, steps=1)
-    fwd, bwd, stash, n_stash = _fwd_bwd_hlos(e)
-    fwd_s8 = [c for c in _gather_ops(fwd) if c.dtype == "s8"]
-    assert len(fwd_s8) == 3
-    assert _gather_ops(bwd) == [], \
-        "backward jit regathers a weight — the stash residual was dropped"
-    hc.assert_no_host_transfers(fwd, "stage-3 fwd jit")
-    hc.assert_no_host_transfers(bwd, "stage-3 bwd jit")
-    # donation: state is argnum 0 (n_state leaves), stash argnum 1 — the
-    # stash's parameter indices start after the flattened state
-    n_state = len(jax.tree_util.tree_leaves(e.state))
-    hc.assert_params_donated(bwd, range(n_state, n_state + n_stash),
-                             "stage-3 bwd (stash handoff)")
-    # runtime half: the fwd did NOT consume the engine state (it is not
-    # donated there)...
-    assert hc.consumed_leaves(e.state) == (0, n_state)
-    # ...the bwd consumes the donated STATE (accum aliases in place), and
-    # the stash's runtime deletions are a subset of its may-alias entries
-    # (donor-only residuals stay readable on this backend — the HLO
-    # donor table above is the complete contract, PR-6 semantics)
-    old_state = e.state
-    with jax.set_mesh(e.mesh):
-        e.state = e._jit_s3_bwd(e.state, stash)
-    hc.assert_consumed(old_state, "stage-3 state after bwd")
-    deleted, _ = hc.consumed_leaves(stash)
-    assert deleted <= len(hc.donated_params(bwd)
-                          & set(range(n_state, n_state + n_stash)))
+# the staged fwd/bwd split contracts (all gathers in the forward,
+# zero in the backward, stash donated across the handoff) are
+# declared on s3_fwd/s3_bwd in the program registry and checked by
+# the --programs autopilot (tests/unit/test_program_lint.py)
 
 
 def test_quantized_all_gather_unit_parity_and_grad(eight_devices):

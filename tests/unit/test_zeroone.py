@@ -306,54 +306,10 @@ def test_zeroone_wire_enabled_by_engine(eight_devices):
     assert engine._zeroone_phase() == ("warmup", 1)
 
 
-def test_zeroone_local_round_compiles_to_zero_collectives(eight_devices):
-    """The skipped-round contract: a local-round program must contain NO
-    cross-device collective at all — zero wire bytes is what makes the
-    k-round amortization in comm_accounting honest."""
-    engine = _zeroone_engine()
-    batch = _batch()
-    engine._ensure_state({k: v[0] for k, v in batch.items()})
-    engine._compile()
-    dev = engine._shard_stacked_batch(batch)
-    with jax.set_mesh(engine.mesh):
-        fn = engine._make_zeroone_fused("local", 2)
-        text = jax.jit(fn).lower(engine.state, dev,
-                                 jnp.float32(1e-2)).compile().as_text()
-    local_bytes, local_ops = _collective_bytes(text)
-    assert local_bytes == 0 and not local_ops, local_ops
-
-
-def test_zeroone_sync_round_wire_contract(eight_devices):
-    """Sync-round HLO: the gradient wire is sub-byte packed (u8/s8) plus
-    fp32 block scales; no fp32/bf16 collective >= 512 elements may
-    appear (that would be a dense gradient sneaking back), and total
-    collective payload stays within the analytic budget x dp/(dp-1)
-    (ring-factor slack) + the scalar overflow/loss all-reduces."""
-    engine = _zeroone_engine()
-    batch = _batch()
-    # cross the freeze so comm_volume_report models the compressed wire
-    for _ in range(3):
-        engine.train_batch(batch=batch)
-    rep = engine.comm_volume_report(refresh=True)
-    ow = rep["optimizer_wire"]
-    assert rep["grad_path_modeled"] is True
-
-    dev = engine._shard_stacked_batch(batch)
-    with jax.set_mesh(engine.mesh):
-        fn = engine._make_zeroone_fused("sync", 2)
-        text = jax.jit(fn).lower(engine.state, dev,
-                                 jnp.float32(1e-2)).compile().as_text()
-    hlo_bytes, ops = _collective_bytes(text)
-
-    assert any(o[1] in ("u8", "s8") for o in ops), \
-        f"no packed sub-byte collective in the sync round: {ops}"
-    big_dense = [o for o in ops if o[1] in ("f32", "bf16") and o[2] >= 512]
-    assert not big_dense, f"dense collective on the 1-bit wire: {big_dense}"
-
-    dp = 8
-    budget = ow["sync_round_bytes"] * dp / (dp - 1)
-    slack = sum(o[3] for o in ops if o[2] <= 8)   # scalar syncs ride along
-    assert hlo_bytes <= budget + slack + 1, (hlo_bytes, budget, slack, ops)
+# the local-round zero-collective and sync-round wire/budget HLO
+# contracts are declared at registration (zeroone_fused:* in the
+# program registry) and checked by the --programs autopilot
+# (tests/unit/test_program_lint.py)
 
 
 def test_zeroone_wire_trains_through_freeze(eight_devices):

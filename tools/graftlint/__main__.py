@@ -7,9 +7,17 @@ Exit status: 0 = no new findings (baselined/suppressed ones don't fail),
 --baseline-update rewrites tools/graftlint/baseline.json to exactly the
 current finding set (pruning stale entries, preserving notes on
 survivors).  Use it ONLY for load-bearing findings you cannot fix, and
-add a ``note`` to the entry saying why it stays.
+add a ``note`` to the entry saying why it stays.  Combined with
+--strict-stale it still exits 1 when stale entries were pruned, so a CI
+run can prune and flag the drift in one invocation.
+
+--programs switches from the source walk to the whole-program HLO lint
+(tools/graftlint/program_lint.py): builds the tiny-engine corpus on an
+8-device CPU mesh and checks every registered jit's compiled HLO
+against its declared contract.
 """
 import argparse
+import os
 import sys
 
 from .core import (DEFAULT_BASELINE, DEFAULT_ROOTS, REGISTRY, _load_rules,
@@ -39,11 +47,21 @@ def main(argv=None):
                     help="print the rule catalog and exit")
     ap.add_argument("--strict-stale", action="store_true",
                     help="also fail (exit 1) on stale baseline entries")
+    ap.add_argument("--programs", action="store_true",
+                    help="lint compiled programs: build the tiny-engine "
+                         "corpus and check every registered jit's HLO "
+                         "against its declared contract")
+    ap.add_argument("--corpus", default=None,
+                    help="with --programs: comma-separated corpus engine "
+                         "subset (default: all)")
     args = ap.parse_args(argv)
 
     rules = _load_rules()
     if args.list_rules:
-        for r in sorted(rules, key=lambda r: r.name):
+        from .program_lint import program_rules
+
+        for r in sorted(list(rules) + program_rules(),
+                        key=lambda r: r.name):
             print(f"{r.name}: {r.description}")
         return 0
     if args.rules:
@@ -55,21 +73,76 @@ def main(argv=None):
             return 2
         rules = [REGISTRY[n] for n in sorted(wanted)]
 
-    try:
-        result = run_paths(roots=args.roots, rules=rules,
-                           baseline_path=args.baseline,
-                           use_baseline=not args.no_baseline)
-    except FileNotFoundError as e:
-        print(f"graftlint: {e}", file=sys.stderr)
-        return 2
+    registries = None
+    if args.programs:
+        # the corpus builds real engines on an 8-device CPU mesh; pin
+        # the backend BEFORE jax's first import (program_lint defers
+        # every jax-touching import for exactly this reason)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        # engine construction logs go to stdout by default; claim the
+        # logger FIRST (utils.logging skips re-config) and point it at
+        # stderr so --json stdout stays machine-parseable
+        import logging
+
+        lg = logging.getLogger("deepspeed_tpu")
+        if not getattr(lg, "_ds_tpu_configured", False):
+            lg.setLevel(logging.INFO)
+            lg.propagate = False
+            handler = logging.StreamHandler(stream=sys.stderr)
+            handler.setFormatter(logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"))
+            lg.addHandler(handler)
+            lg._ds_tpu_configured = True
+        else:
+            for h in lg.handlers:
+                if isinstance(h, logging.StreamHandler):
+                    h.setStream(sys.stderr)
+        from .program_lint import build_corpus, lint_programs, program_rules
+
+        rules = program_rules()
+        only = [n.strip() for n in args.corpus.split(",")] \
+            if args.corpus else None
+        try:
+            registries = build_corpus(only=only)
+        except ValueError as e:
+            print(f"graftlint: {e}", file=sys.stderr)
+            return 2
+        result = lint_programs(registries, baseline_path=args.baseline,
+                               use_baseline=not args.no_baseline)
+    else:
+        try:
+            result = run_paths(roots=args.roots, rules=rules,
+                               baseline_path=args.baseline,
+                               use_baseline=not args.no_baseline)
+        except FileNotFoundError as e:
+            print(f"graftlint: {e}", file=sys.stderr)
+            return 2
     if args.baseline_update:
         data = save_baseline(result, path=args.baseline)
         print(f"graftlint: baseline updated — {len(data['entries'])} "
               f"entr{'y' if len(data['entries']) == 1 else 'ies'}, "
               f"{len(result.stale)} stale pruned")
-        return 0
-    print(report_json(result, rules) if args.json
-          else report_text(result, rules))
+        # exit code and prune must AGREE: with --strict-stale, pruning
+        # stale entries still reports the drift (the baseline changed
+        # under CI's feet) instead of silently returning 0
+        return 1 if (args.strict_stale and result.stale) else 0
+    if args.json and registries is not None:
+        # ship the registry view alongside the findings: program names
+        # (registry-completeness checks) and resolved contracts (the
+        # ported HLO-contract declarations) in one artifact
+        import json
+
+        data = json.loads(report_json(result, rules))
+        data["programs"] = {reg.engine: reg.summary()
+                            for reg in registries}
+        print(json.dumps(data, indent=2, sort_keys=True))
+    else:
+        print(report_json(result, rules) if args.json
+              else report_text(result, rules))
     if result.new or (args.strict_stale and result.stale):
         return 1
     return 0

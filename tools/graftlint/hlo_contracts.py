@@ -292,6 +292,19 @@ def assert_consumed(tree, what: str = "donated argument",
     return deleted
 
 
+def entry_params(hlo_text: str) -> Optional[List[tuple]]:
+    """(dtype, element count) of each ENTRY parameter in order, or None
+    when no ENTRY signature line is found.  Parameter numbers here are
+    the same flat indices the donation tables (:func:`donated_params` /
+    :func:`buffer_donors`) speak — jax flattens jit arguments in order."""
+    for line in hlo_text.splitlines():
+        m = re.search(r"^ENTRY\s+[^(]*\((.*)\)\s*->", line)
+        if m:
+            return [(dtype, _shape_elements(dims))
+                    for dtype, dims in _SHAPE_RE.findall(m.group(1))]
+    return None
+
+
 def entry_output_dtypes(hlo_text: str) -> Optional[List[str]]:
     """Result dtypes of the module's ENTRY computation, or None when no
     ENTRY signature line is found (HLO text format drift)."""

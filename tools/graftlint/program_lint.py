@@ -1,0 +1,595 @@
+"""Whole-program HLO lint — contract autopilot over every registered jit.
+
+The AST rules (tools/graftlint/rules/) police source text and the
+hlo_contracts helpers police ONE hand-lowered jit per test.  This pass
+closes the gap between them: every engine (base, pipeline, serving)
+registers each jit it builds into a ``telemetry.programs.ProgramRegistry``
+with declarative contract metadata (wire_dtype, donates,
+host_transfer_free, collective_free, comm_budget_key, boundary_dtypes,
+...), and the lint iterates the registry, lazily lowers each program
+(the mfu capture-by-shape closure idiom — compilation happens here, off
+the hot path), and holds the compiled HLO to its declared contract.
+Registering a new jit IS opting into coverage; no per-jit test needed.
+
+Three analyses beyond the ported hlo_contracts checks:
+
+- **collective order** (``program-collective-order``): programs sharing
+  a ``uniform_group`` must post the identical (op, dtype) collective
+  sequence — the HLO-level extension of the AST rank-branch-collective
+  rule.  Two SPMD programs that dispatch in the same step but disagree
+  on collective order are a static deadlock.
+- **wire widening** (``program-wire-widening``): a program declaring a
+  sub-fp32 wire (``wire_dtype``) must move no wide-dtype collective at
+  gradient size — the GSPMD failure class where the partitioner
+  commutes a convert across the collective and silently re-widens a
+  quantized wire (see test_quantization.py).
+- **recompile hazard / silent copy** (``program-donation``): every
+  declared-donated input must appear in the compiled module's
+  input_output_alias or buffer_donor table; a dropped donation means
+  the "in-place" update pays a full copy per call.
+
+Findings report through graftlint's existing baseline/JSON machinery
+under pseudo-paths ``<engine:program>``.  Source-line suppression
+comments don't apply here — acknowledge a load-bearing violation by
+baselining it (``--baseline-update`` + a note) or fix the contract.
+
+CLI: ``python -m tools.graftlint --programs [--json]`` builds the
+tiny-engine corpus below and lints it; tests/unit/test_program_lint.py
+wires the same run as the tier-1 autopilot test.
+"""
+from typing import Dict, List, Optional, Sequence
+
+from .core import DEFAULT_BASELINE, Finding, RunResult, fingerprint, \
+    load_baseline
+from . import hlo_contracts as hc
+
+#: dtypes a "wire" contract considers wide — a declared sub-fp32 wire
+#: must not move gradient-sized payloads in any of these.
+WIDE_DTYPES = ("f32", "f64", "bf16", "f16")
+
+#: payloads at or above this element count are "gradient-sized" unless
+#: the contract overrides via ``wire_min_elements``.
+DEFAULT_WIRE_MIN_ELEMENTS = 512
+
+
+class ProgramRule:
+    """Catalog stub for a program-lint check (the checks themselves run
+    in :func:`lint_entry`; this carries name/description for reporting
+    parity with the AST ``Rule`` registry)."""
+
+    def __init__(self, name: str, description: str):
+        self.name = name
+        self.description = description
+
+
+PROGRAM_RULES: Dict[str, ProgramRule] = {r.name: r for r in [
+    ProgramRule("program-lower-error",
+                "a registered program failed to lower/compile — its "
+                "contract cannot be checked (registration drift)"),
+    ProgramRule("program-host-transfer",
+                "program declared host_transfer_free but the compiled "
+                "module contains infeed/outfeed/host-callback ops"),
+    ProgramRule("program-collective-free",
+                "program declared collective_free but the compiled "
+                "module posts collectives"),
+    ProgramRule("program-wire-widening",
+                "program declares a sub-fp32 wire but a wide-dtype "
+                "collective moves a gradient-sized payload (GSPMD "
+                "re-widened the quantized wire)"),
+    ProgramRule("program-forbidden-collective",
+                "program forbids specific collective ops (e.g. a "
+                "backward that must not remat-refetch via all-gather) "
+                "but the compiled module posts one"),
+    ProgramRule("program-op-count",
+                "collective op/dtype count differs from the contract "
+                "(e.g. stage-3 must gather each partitioned leaf "
+                "exactly once)"),
+    ProgramRule("program-collective-budget",
+                "total collective payload exceeds the analytic byte "
+                "budget from runtime/comm_accounting.py"),
+    ProgramRule("program-donation",
+                "declared-donated input missing from the compiled "
+                "input_output_alias/buffer_donor tables — the donation "
+                "was dropped (silent copy per call)"),
+    ProgramRule("program-output-alias",
+                "a result the contract pins as written-into-donated-"
+                "memory allocates a fresh buffer instead"),
+    ProgramRule("program-boundary-dtype",
+                "the ENTRY signature emits a dtype outside the declared "
+                "boundary set (e.g. a bf16 pipeline boundary upcast to "
+                "f32 doubles the p2p bytes)"),
+    ProgramRule("program-collective-order",
+                "programs sharing a uniform_group disagree on their "
+                "(op, dtype) collective sequence — static SPMD "
+                "deadlock"),
+]}
+
+
+def program_rules() -> List[ProgramRule]:
+    return list(PROGRAM_RULES.values())
+
+
+def _cget(contract: dict, key: str, default=None):
+    """Contract lookup that treats an explicit None value as absent
+    (engines register e.g. ``expect_op_counts: None`` when the arming
+    state that would pin the count isn't available)."""
+    v = contract.get(key, default)
+    return default if v is None else v
+
+
+def _fmt_ops(ops: Sequence[hc.CollectiveOp], limit: int = 3) -> str:
+    return ", ".join(f"{c.op}[{c.dtype}x{c.elements}]" for c in ops[:limit])
+
+
+def collective_order(hlo_text: str) -> List[tuple]:
+    """The program's collective sequence as (op, dtype) pairs, in module
+    order — the signature two SPMD programs must agree on to be
+    deadlock-free when dispatched in the same step."""
+    return [(c.op, c.dtype) for c in hc.collective_ops(hlo_text)]
+
+
+def lint_entry(engine: str, entry) -> List[Finding]:
+    """Run every applicable contract check on one registered program.
+
+    ``entry`` is a ``telemetry.programs.ProgramEntry``; its ``hlo()``
+    lazily lowers+compiles (cached).  Cross-program checks (collective
+    order) live in :func:`lint_programs`.
+    """
+    path = f"<{engine}:{entry.name}>"
+    c = entry.contract or {}
+    out: List[Finding] = []
+
+    def emit(rule, message):
+        out.append(Finding(rule=rule, path=path, line=0, message=message))
+
+    try:
+        hlo = entry.hlo()
+    except Exception as e:  # registration drift must not crash the lint
+        emit("program-lower-error",
+             f"failed to lower/compile: {type(e).__name__}: {e}")
+        return out
+    ops = hc.collective_ops(hlo)
+
+    if _cget(c, "host_transfer_free"):
+        hits = hc.host_transfer_ops(hlo)
+        if hits:
+            emit("program-host-transfer",
+                 f"declared host_transfer_free but compiled module has "
+                 f"{len(hits)} host-transfer op(s): {hits[0]}")
+
+    if _cget(c, "collective_free"):
+        if ops:
+            emit("program-collective-free",
+                 f"declared collective_free but compiled module posts "
+                 f"{len(ops)} collective(s): {_fmt_ops(ops)}")
+
+    wire = _cget(c, "wire_dtype")
+    if wire:
+        declared = {wire} if isinstance(wire, str) else set(wire)
+        min_el = int(_cget(c, "wire_min_elements",
+                           DEFAULT_WIRE_MIN_ELEMENTS))
+        wide = [o for o in ops
+                if o.dtype in WIDE_DTYPES and o.dtype not in declared
+                and o.elements >= min_el]
+        if wide:
+            emit("program-wire-widening",
+                 f"declares {sorted(declared)} wire but moves "
+                 f"wide-dtype payload(s) >= {min_el} elements through "
+                 f"collectives: {_fmt_ops(wide)}")
+        elif ops and not any(o.dtype in declared for o in ops) \
+                and any(o.elements >= min_el for o in ops):
+            emit("program-wire-widening",
+                 f"declares {sorted(declared)} wire but no collective "
+                 f"rides it — the whole wire compiled to "
+                 f"{sorted({o.dtype for o in ops})}")
+
+    forbid = _cget(c, "forbid_collectives")
+    if forbid:
+        hits = [o for o in ops if o.op in set(forbid)]
+        if hits:
+            emit("program-forbidden-collective",
+                 f"contract forbids {sorted(set(forbid))} but compiled "
+                 f"module posts: {_fmt_ops(hits)}")
+
+    for spec in _cget(c, "expect_op_counts", ()) or ():
+        if not spec:
+            continue
+        op, dtype, count = spec
+        got = sum(1 for o in ops if o.op == op and o.dtype == dtype)
+        if got != int(count):
+            emit("program-op-count",
+                 f"expected exactly {count} {op}[{dtype}] collective(s), "
+                 f"compiled module has {got}")
+
+    budget = _cget(c, "comm_budget_bytes")
+    if budget is not None:
+        key = _cget(c, "comm_budget_key", "comm_budget_bytes")
+        try:
+            budget = int(budget() if callable(budget) else budget)
+        except Exception as e:
+            emit("program-collective-budget",
+                 f"budget callable for {key!r} raised "
+                 f"{type(e).__name__}: {e}")
+            budget = None
+        if budget is not None:
+            cutoff = int(_cget(c, "comm_small_op_cutoff", 0))
+            measured = sum(o.bytes for o in ops if o.elements > cutoff)
+            if measured > budget:
+                emit("program-collective-budget",
+                     f"moves {measured} collective bytes (ops > {cutoff} "
+                     f"elements), over the analytic budget {budget} "
+                     f"({key}): {_fmt_ops(ops)}")
+
+    donates = _cget(c, "donates")
+    if donates:
+        got = hc.donated_params(hlo) | hc.buffer_donors(hlo)
+        # the alias tables speak ENTRY parameter numbers; jit prunes
+        # unused args by default, so translate declared FLAT indices
+        # through the lowering's kept_var_idx (a pruned arg is never
+        # copied — trivially satisfied)
+        kept = entry.kept_var_idx
+        if kept is not None:
+            pos_of = {flat: pos for pos, flat in enumerate(kept)}
+            declared = [(i, pos_of[i]) for i in
+                        sorted(set(int(i) for i in donates))
+                        if i in pos_of]
+        else:
+            declared = [(i, i) for i in sorted(set(int(i)
+                                                   for i in donates))]
+        missing = [(i, pos) for i, pos in declared if pos not in got]
+        min_el = int(_cget(c, "donation_min_elements", 0))
+        if missing and min_el:
+            # exempt sub-threshold leaves (rng keys, step counters):
+            # XLA declines to alias tiny pass-through buffers and the
+            # copy cost is nil — the hazard this rule exists for is a
+            # dropped FULL-STATE donation
+            params = hc.entry_params(hlo)
+            if params is not None:
+                missing = [(i, pos) for i, pos in missing
+                           if pos < len(params)
+                           and params[pos][1] >= min_el]
+        if missing:
+            emit("program-donation",
+                 f"declared-donated parameter(s) "
+                 f"{[i for i, _ in missing]} (flat arg indices) missing "
+                 f"from input_output_alias/buffer_donor tables (donated "
+                 f"entry params: {sorted(got) or 'none'}) — silent copy "
+                 f"per call")
+
+    n_aliased = _cget(c, "outputs_aliased")
+    if n_aliased:
+        got = hc.aliased_outputs(hlo)
+        missing = [i for i in range(int(n_aliased)) if i not in got]
+        if missing:
+            emit("program-output-alias",
+                 f"output(s) {missing} of {n_aliased} must be written "
+                 f"into donated memory but allocate fresh buffers "
+                 f"(aliased: {sorted(got) or 'none'})")
+
+    boundary = _cget(c, "boundary_dtypes")
+    if boundary:
+        allowed = {boundary} if isinstance(boundary, str) else set(boundary)
+        got = hc.entry_output_dtypes(hlo)
+        if got is None:
+            emit("program-boundary-dtype",
+                 "could not parse the ENTRY signature to check the "
+                 "declared boundary dtypes (HLO text format drift)")
+        else:
+            extra = sorted({d for d in got if d not in allowed})
+            if extra:
+                emit("program-boundary-dtype",
+                     f"boundary must stay in {sorted(allowed)} but the "
+                     f"ENTRY signature emits {extra} (outputs: {got})")
+
+    return out
+
+
+def lint_programs(registries, baseline_path: str = DEFAULT_BASELINE,
+                  use_baseline: bool = True) -> RunResult:
+    """Lint every program in ``registries`` (iterable of
+    ProgramRegistry); returns a core.RunResult so report_text /
+    report_json / save_baseline work unchanged.  Pseudo-paths of ALL
+    scanned programs (clean ones included) count as covered, so stale
+    program baseline entries are judged and pruned exactly like stale
+    file entries."""
+    result = RunResult(rule_names=set(PROGRAM_RULES))
+    findings: List[Finding] = []
+    groups: Dict[str, list] = {}
+    for reg in registries:
+        for entry in reg.entries():
+            path = f"<{reg.engine}:{entry.name}>"
+            result.scanned_paths.add(path)
+            findings.extend(lint_entry(reg.engine, entry))
+            group = _cget(entry.contract or {}, "uniform_group")
+            if group:
+                # scoped per registry: programs from different engines
+                # never dispatch in the same SPMD cohort
+                groups.setdefault((reg.engine, group), []) \
+                    .append((path, entry))
+
+    # cross-program: collective-order consistency per uniform_group
+    for engine, group in sorted(groups):
+        members = sorted(groups[(engine, group)], key=lambda pe: pe[0])
+        orders = []
+        for path, entry in members:
+            try:
+                orders.append((path, collective_order(entry.hlo())))
+            except Exception:  # lint: allow-broad-except — the lower
+                # failure is already reported per-entry by lint_entry
+                pass
+        if len(orders) < 2:
+            continue
+        ref_path, ref_order = orders[0]
+        for path, order in orders[1:]:
+            if order != ref_order:
+                findings.append(Finding(
+                    rule="program-collective-order", path=path, line=0,
+                    message=f"collective order diverges from {ref_path} "
+                            f"within uniform_group {group!r}: "
+                            f"{order} vs {ref_order} — programs "
+                            f"dispatched in the same step would "
+                            f"deadlock"))
+
+    seen_occ: Dict[tuple, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.rule, f.message)):
+        k = (f.path, f.rule, f.message)
+        occ = seen_occ.get(k, 0)
+        seen_occ[k] = occ + 1
+        result.fingerprints[fingerprint(f, f.message, occ)] = f
+
+    baseline = load_baseline(baseline_path) if use_baseline \
+        else {"entries": []}
+    known = {e["fingerprint"]: e for e in baseline["entries"]}
+    for fp, f in result.fingerprints.items():
+        (result.baselined if fp in known else result.new).append(f)
+    live = set(result.fingerprints)
+    result.stale = [e for e in baseline["entries"]
+                    if e["fingerprint"] not in live and result.covers(e)]
+    result.new.sort(key=lambda f: (f.path, f.line, f.rule))
+    result.baselined.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result
+
+
+# ---------------------------------------------------------------------------
+# corpus: tiny engines covering every program family the repo builds
+# ---------------------------------------------------------------------------
+
+def _corpus_base_qgz():
+    """Stage-2 + quantized (qgZ) gradients: micro_step on the s8 wire,
+    apply_step, eval_loss."""
+    import numpy as np
+
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel
+
+    hidden = 32
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=hidden), config_params={
+            "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.02}},
+            "zero_optimization": {"stage": 2, "quantized_gradients": True},
+            "mesh": {"data": 8}, "steps_per_print": 10 ** 9})
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.standard_normal((8, hidden)).astype(np.float32),
+             "y": rng.integers(0, 4, (8,)).astype(np.int32)}
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    engine.eval_loss(batch)
+    assert engine._qgz_armed
+    reg = engine.program_registry
+    reg.engine = "base-qgz"
+    return reg
+
+
+def _corpus_stage3():
+    """Scheduled ZeRO-3: split s3_fwd/s3_bwd (stash handoff) +
+    apply_step — the once-per-micro s8 gather wire."""
+    import numpy as np
+
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel
+
+    hidden = 16
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=hidden), config_params={
+            "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 0.02}},
+            "zero_optimization": {"stage": 3},
+            "mesh": {"data": 8}, "steps_per_print": 10 ** 9})
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.standard_normal((8, hidden)).astype(np.float32),
+             "y": rng.integers(0, 4, (8,)).astype(np.int32)}
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    assert engine._s3_sched_armed
+    reg = engine.program_registry
+    reg.engine = "stage3"
+    return reg
+
+
+def _corpus_zeroone():
+    """0/1 Adam fused train step: warmup, local (collective-free) and
+    sync (packed u8/s8 wire) rounds all registered by phase name."""
+    import numpy as np
+
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel
+
+    hidden = 64
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=hidden), config_params={
+            "train_batch_size": 16, "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "ZeroOneAdam",
+                          "params": {"lr": 1e-2, "var_freeze_step": 3,
+                                     "local_steps": 2}},
+            "mesh": {"data": 8}, "steps_per_print": 10 ** 9})
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.standard_normal((1, 16, hidden)).astype(np.float32),
+             "y": rng.integers(0, 4, (1, 16)).astype(np.int32)}
+    # 5 steps cross the freeze: warmup x3, then one local + one sync round
+    for _ in range(5):
+        engine.train_batch(batch=batch)
+    reg = engine.program_registry
+    reg.engine = "zeroone"
+    return reg
+
+
+def _corpus_onebit():
+    """1-bit Adam fused train step: dense warmup + frozen (sign-packed
+    u8 wire) programs."""
+    import numpy as np
+
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel
+
+    hidden = 64
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=hidden), config_params={
+            "train_batch_size": 16, "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "OneBitAdam",
+                          "params": {"lr": 1e-2, "freeze_step": 3}},
+            "mesh": {"data": 8}, "steps_per_print": 10 ** 9})
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.standard_normal((1, 16, hidden)).astype(np.float32),
+             "y": rng.integers(0, 4, (1, 16)).astype(np.int32)}
+    for _ in range(4):
+        engine.train_batch(batch=batch)
+    reg = engine.program_registry
+    reg.engine = "onebit"
+    return reg
+
+
+def _corpus_pipeline():
+    """zb-h1 pipeline (2 stages x data 2): fwd / fwd_stash / zb dgrad +
+    wgrad split / apply, per chunk — the stash-donation family."""
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
+    from tests.unit.simple_model import make_stack_specs, random_dataloader
+
+    specs, loss_fn, input_fn = make_stack_specs(16, 6, tied_head=False)
+    module = PipelineModule(specs, loss_fn=loss_fn, input_fn=input_fn,
+                            partition_method="uniform")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=module, config_params={
+            "train_batch_size": 8, "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "pipeline": {"schedule": "zb-h1"},
+            "mesh": {"pipe": 2, "data": 2, "model": 1,
+                     "allow_partial": True},
+            "steps_per_print": 10 ** 9})
+    engine.train_batch(data_iter=random_dataloader(16, 64, 4))
+    assert engine._stash_armed
+    reg = engine.program_registry
+    reg.engine = "pipe"
+    return reg
+
+
+def _corpus_pipe_bf16():
+    """bf16 GPT-2 pipeline: the boundary-transfer contract — a bf16
+    stage's boundary activation leaves in bf16 (an f32 boundary would
+    double the p2p bytes pipeline_report budgets per edge)."""
+    import numpy as np
+
+    import deepspeed_tpu
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2Config
+    from deepspeed_tpu.models.gpt2_pipe import gpt2_pipeline_module
+
+    cfg = GPT2Config(vocab_size=64, n_positions=16, n_embd=32, n_layer=2,
+                     n_head=4, dtype=jnp.bfloat16, loss_chunk_tokens=0)
+    module = gpt2_pipeline_module(cfg, partition_method="uniform")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=module, config_params={
+            "train_batch_size": 8, "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True},
+            "pipeline": {"schedule": "zb-h1"},
+            "mesh": {"pipe": 2, "data": 2, "model": 1,
+                     "allow_partial": True},
+            "steps_per_print": 10 ** 9})
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 64, (2, 4, 16))
+    engine.train_batch(batch={"input_ids": ids, "labels": ids.copy()})
+    reg = engine.program_registry
+    reg.engine = "pipe-bf16"
+    return reg
+
+
+def _corpus_serving():
+    """Continuous-batching serving, two engines: a plain one (the
+    decode_step jit — speculative replaces it wholesale) and one with
+    prefix cache + speculative decoding (prefill buckets, COW page
+    copy, spec verify)."""
+    import numpy as np
+
+    import jax
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.serving.engine import InferenceEngine
+
+    import jax.numpy as jnp
+
+    cfg = GPT2Config(vocab_size=97, n_positions=64, n_embd=32, n_layer=2,
+                     n_head=4, dtype=jnp.float32, loss_chunk_tokens=0)
+    model = GPT2Model(cfg)
+    ids = np.random.default_rng(0).integers(0, 97, (2, 8))
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": ids, "labels": ids})
+    rng = np.random.default_rng(1)
+
+    plain = InferenceEngine(model, params, max_slots=3, kv_block_size=4,
+                            prefill_chunk=8, max_blocks_per_seq=8)
+    plain.submit(rng.integers(0, 97, 5).astype(np.int32),
+                 max_new_tokens=4)
+    plain.serve(max_steps=100)
+    plain.program_registry.engine = "serving"
+
+    spec = InferenceEngine(model, params, max_slots=3, kv_block_size=4,
+                           prefill_chunk=8, max_blocks_per_seq=8,
+                           prefix_cache=True, speculative=3)
+    # two requests sharing a prefix: the second forks COW pages off the
+    # cached prefix; speculative drafting covers the verify jit
+    shared = rng.integers(0, 97, 9).astype(np.int32)
+    spec.submit(shared, max_new_tokens=6)
+    spec.serve(max_steps=200)
+    spec.submit(np.concatenate([shared, rng.integers(0, 97, 3)])
+                .astype(np.int32), max_new_tokens=6)
+    spec.serve(max_steps=200)
+    spec.program_registry.engine = "serving-spec"
+    return [plain.program_registry, spec.program_registry]
+
+
+CORPUS_BUILDERS = {
+    "base-qgz": _corpus_base_qgz,
+    "stage3": _corpus_stage3,
+    "zeroone": _corpus_zeroone,
+    "onebit": _corpus_onebit,
+    "pipe": _corpus_pipeline,
+    "pipe-bf16": _corpus_pipe_bf16,
+    "serving": _corpus_serving,
+}
+
+
+def build_corpus(only: Optional[Sequence[str]] = None):
+    """Build the tiny-engine corpus and return its ProgramRegistry list.
+
+    ``only`` restricts to a subset of CORPUS_BUILDERS keys (test-time
+    slicing); default is every engine family.  Runs on the 8-device CPU
+    mesh — the caller (CLI / conftest) must set JAX_PLATFORMS=cpu and
+    the host-platform device-count flag BEFORE jax is first imported.
+    """
+    names = list(CORPUS_BUILDERS) if only is None else list(only)
+    unknown = set(names) - set(CORPUS_BUILDERS)
+    if unknown:
+        raise ValueError(f"unknown corpus engine(s) {sorted(unknown)}; "
+                         f"known: {sorted(CORPUS_BUILDERS)}")
+    registries = []
+    for n in names:
+        built = CORPUS_BUILDERS[n]()
+        registries.extend(built if isinstance(built, list) else [built])
+    return registries

@@ -32,10 +32,16 @@ COLLECTIVES = {
     "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
     "all_to_all", "psum_scatter",
     # repo custom collectives
-    "quantized_reduce_scatter", "onebit_allreduce",
+    "quantized_reduce_scatter", "quantized_all_gather",
+    "quantized_all_reduce", "onebit_allreduce",
     # host-level coordination barriers
     "process_allgather", "broadcast_one_to_all", "sync_global_devices",
     "all_agree", "broadcast_tag",
+    # transport-level barriers (runtime/resilience/transport.py): every
+    # live peer must post the same heartbeat/vote round or the quorum
+    # wedges exactly like a rank-gated device collective.  "submit" is
+    # deliberately NOT matched — serving has an unrelated submit()
+    "vote_dead", "heartbeat_tick",
 }
 
 

@@ -640,6 +640,37 @@ def serving_kv_handoff_bytes(n_layer: int, n_head: int, head_dim: int, *,
         kv_dtype=kv_dtype, quantized=quantized))
 
 
+def serving_gather_bytes_per_step(
+        n_layer: int, n_head: int, block_size: int, head_dim: int, *,
+        pages: int, batch: int = 1, kv_dtype: str = "float32",
+        quantized: bool = False) -> int:
+    """HBM bytes ONE decode step's KV gather reads: K and V of
+    ``pages`` pool pages per lane, per layer — the memory-bound side of
+    decode, and where the sparse page policy's active-page factor lands
+    (``pages`` is the page-table width W dense, the policy's fixed K
+    sparse — the serve_bench A/B's ≥4x claim IS this ratio).  int8
+    pools read int8 rows plus the per-(token, head) f32 scales, the
+    same layout ``kv_cache._pool_view`` dequantizes."""
+    store = 1 if quantized else DTYPE_BYTES[kv_dtype]
+    rows = int(batch) * n_layer * int(pages) * n_head * block_size
+    kv = 2 * rows * head_dim * store
+    scales = 2 * rows * 4 if quantized else 0
+    return kv + scales
+
+
+def serving_decode_attn_flops(n_layer: int, n_head: int, head_dim: int, *,
+                              attended: int, batch: int = 1) -> int:
+    """Attention FLOPs of ONE decode step: per (lane, layer, head), the
+    single query scores ``attended`` key positions (2 * D FLOPs each:
+    the QK dot) and mixes as many value rows (another 2 * D) — 4 * D *
+    attended per head.  ``attended`` carries the active-page factor:
+    ``W * block_size`` dense, the policy's ``K * block_size`` under a
+    sparse window — the compute twin of
+    :func:`serving_gather_bytes_per_step`.  The projection GEMMs are
+    policy-independent and priced by the MFU ledger, not here."""
+    return int(batch) * n_layer * n_head * 4 * head_dim * int(attended)
+
+
 def zero_shard_dim(shape: Sequence[int], dp: int,
                    taken: Sequence[int] = ()) -> Optional[int]:
     """The dimension mesh.zero_merge_spec would shard over 'data': the

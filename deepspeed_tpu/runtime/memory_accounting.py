@@ -270,6 +270,25 @@ def kv_pool_bytes(n_layer: int, num_blocks: int, n_head: int,
     return kv + scales
 
 
+def sparse_kv_blocks_per_seq(n_positions: int, block_size: int, *,
+                             num_sliding_window_blocks: int,
+                             num_global_blocks: int = 1) -> int:
+    """RESIDENT pool blocks one sequence of ``n_positions`` tokens holds
+    under a sliding-window + global-anchor sparse attention policy
+    (serving/sparse_context.py) with window-expired reclamation: the
+    ``num_global_blocks`` anchors stay pinned and only the trailing
+    ``num_sliding_window_blocks`` window stays mapped — everything
+    between has been returned to the allocator.  This is the
+    active-page factor long-context pool sizing composes into
+    :func:`kv_pool_bytes`: ``num_blocks ~= slots *
+    sparse_kv_blocks_per_seq(...) + shards`` instead of ``slots *
+    ceil(n_positions / block_size) + shards``.  Short sequences that
+    never outgrow the window are priced at their dense footprint."""
+    assert num_sliding_window_blocks >= 1 and num_global_blocks >= 0
+    dense = -(-int(n_positions) // int(block_size))
+    return min(dense, num_global_blocks + num_sliding_window_blocks)
+
+
 def train_memory_report(leaves: Sequence[LeafSpec], dp: int, *,
                         zero_stage: int = 0,
                         compute_dtype="float32",

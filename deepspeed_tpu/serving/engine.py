@@ -66,6 +66,8 @@ from deepspeed_tpu.serving.reliability import (ABORT_BUDGET, ABORT_EXPIRED,
                                                RequestJournal)
 from deepspeed_tpu.serving.scheduler import (Request, RequestState,
                                              Scheduler)
+from deepspeed_tpu.serving.sparse_context import (SparseContext,
+                                                  _policy_layout)
 from deepspeed_tpu.utils.jax_compat import ensure_compat
 from deepspeed_tpu.utils.logging import logger
 
@@ -116,7 +118,7 @@ def _pool_view(pool, scales, l, tables, quantized, out_dtype):
 
 
 def _paged_forward(params, cfg, pools, tables, pos, maxpos, blk, off, x,
-                   quantized):
+                   quantized, sparse=None, allowed=None):
     """Shared transformer pass of decode and chunked prefill: per layer,
     write this step's K/V rows into the pool, gather the page view, and
     run the SAME attention core the contiguous cache uses.  x: (B, T, E)
@@ -132,16 +134,41 @@ def _paged_forward(params, cfg, pools, tables, pos, maxpos, blk, off, x,
     block at a masked position.  For finite garbage the zeroing is
     bit-neutral (0 * garbage was already exactly +/-0), so the parity
     contract is untouched while per-request fault ISOLATION becomes
-    unconditional."""
+    unconditional.
+
+    ``sparse`` (serving/sparse_context.py): ``(stables, sbase)`` — a
+    (B, K) physical-page gather table plus the absolute view position of
+    each page's first token.  The GATHER then reads only K active pages
+    per lane while WRITES keep addressing the full page table through
+    ``blk``/``off``; padded/expired entries carry the sentinel position
+    (>= every valid pos/maxpos), so both masks reject them exactly like
+    dense trash padding.  Attention is permutation-invariant over keys,
+    so view order no longer being position order changes nothing — the
+    masks are built from the TRUE absolute positions.  ``allowed``
+    (B?, T, K*bs) further restricts each query to its OWN policy blocks
+    (chunked prefill gathers the chunk's union set)."""
     pk, pv, ksc, vsc = pools
     B, T, _ = x.shape
     H, D = cfg.n_head, cfg.head_dim
     W = tables.shape[1]
     bs = pk.shape[3]
-    validj = (jnp.arange(W * bs)[None, :] <= pos.reshape(B, T)[:, :, None]) \
-        .reshape(B, T, W * bs)[:, None]                  # (B, 1, T, K)
-    validk = (jnp.arange(W * bs)[None, :] <= maxpos[:, None]) \
-        [:, None, :, None]                               # (B, 1, K, 1)
+    if sparse is None:
+        gtables = tables
+        validj = (jnp.arange(W * bs)[None, :]
+                  <= pos.reshape(B, T)[:, :, None]) \
+            .reshape(B, T, W * bs)[:, None]              # (B, 1, T, K)
+        validk = (jnp.arange(W * bs)[None, :] <= maxpos[:, None]) \
+            [:, None, :, None]                           # (B, 1, K, 1)
+    else:
+        gtables, sbase = sparse
+        K = gtables.shape[1]
+        view_pos = (sbase[:, :, None] + jnp.arange(bs)[None, None, :]) \
+            .reshape(B, K * bs)                          # (B, K*bs)
+        validj = view_pos[:, None, :] <= pos.reshape(B, T)[:, :, None]
+        if allowed is not None:
+            validj = validj & allowed
+        validj = validj[:, None]                         # (B, 1, T, K*bs)
+        validk = (view_pos <= maxpos[:, None])[:, None, :, None]
     for l, bp in enumerate(_block_params(params, cfg)):
         h = _ln(x, bp["ln_1"], cfg.layer_norm_epsilon)
         qkv = _dense(h, bp["attn"]["c_attn"])
@@ -151,8 +178,8 @@ def _paged_forward(params, cfg, pools, tables, pos, maxpos, blk, off, x,
         vt = v.reshape(B * T, H, D)
         pk, ksc = _pool_write(pk, ksc, l, blk, off, kt, quantized)
         pv, vsc = _pool_write(pv, vsc, l, blk, off, vt, quantized)
-        kview = _pool_view(pk, ksc, l, tables, quantized, x.dtype)
-        vview = _pool_view(pv, vsc, l, tables, quantized, x.dtype)
+        kview = _pool_view(pk, ksc, l, gtables, quantized, x.dtype)
+        vview = _pool_view(pv, vsc, l, gtables, quantized, x.dtype)
         kview = jnp.where(validk, kview, 0)
         vview = jnp.where(validk, vview, 0)
         a = _attn_core(q, kview, vview, validj, bp["attn"], x.dtype)
@@ -319,6 +346,94 @@ def _make_prefill_chunk(cfg, C, W, bs, quantized, final, temperature,
                        n_out_streams=2 if final else 0)
 
 
+@functools.lru_cache(maxsize=64)
+def _make_sparse_decode_step(cfg, W, K, bs, quantized, temperature, top_k,
+                             top_p, mesh, axis_name):
+    """Sparse-policy decode: identical to :func:`_make_decode_step`
+    except the KV gather reads the K-page active table instead of the
+    full W-page table.  K is STATIC (the policy's fixed gather width),
+    so this is still one fixed-shape program inside the zero-recompile
+    pin; the host refreshes ``stables``/``sbase`` per step with the same
+    no-mutation-before-fetch discipline as ``_pos``/``_tok``.  The
+    single decode query needs no per-query ``allowed`` mask: its active
+    row IS exactly its own policy set (lut row of its query block)."""
+    def run(params, *args):
+        pools = args[:4] if quantized else args[:2] + (None, None)
+        tables, stables, sbase, pos, tok, active, seeds, poison = args[-8:]
+        S = tok.shape[0]
+        x = params["wte"].astype(cfg.dtype)[tok][:, None, :] \
+            + params["wpe"].astype(cfg.dtype)[pos][:, None, :]   # (S, 1, E)
+        x = x + poison.astype(cfg.dtype)[:, None, None]
+        blk = jnp.where(active, tables[jnp.arange(S), pos // bs],
+                        TRASH_BLOCK)
+        off = pos % bs
+        x, pools = _paged_forward(params, cfg, pools, tables, pos, pos,
+                                  blk, off, x, quantized,
+                                  sparse=(stables, sbase))
+        logits = _lm_logits(params, cfg, x[:, 0])
+        finite = jnp.isfinite(logits).all(axis=-1)
+        nxt = _pick_next(logits, seeds, pos, temperature, top_k, top_p)
+        nxt = jnp.where(active, nxt, 0).astype(jnp.int32)
+        out = pools[:4] if quantized else pools[:2]
+        return (*out, nxt, finite)
+
+    n_pool = 4 if quantized else 2
+    return _shard_wrap(run, mesh, axis_name, n_pool,
+                       in_streams=(True,) * 8, n_out_streams=2)
+
+
+@functools.lru_cache(maxsize=256)
+def _make_sparse_prefill_chunk(cfg, C, W, K, bs, win, g, quantized, final,
+                               temperature, top_k, top_p, mesh, axis_name):
+    """Sparse-policy prefill chunk: the gather row is the UNION of the
+    chunk queries' active sets (globals + one contiguous window run —
+    fixed width K per bucket, see ``SparseContext.prefill_K``), so an
+    early query's gather would include blocks below its OWN window; the
+    trace-constant policy layout masks those per (query, key-block)
+    pair inside the jit.  Same shard semantics as the dense chunk:
+    non-owner shards get n_valid == 0 and all-sentinel sparse rows."""
+    def run(params, *args):
+        pools = args[:4] if quantized else args[:2] + (None, None)
+        table_rows, stab_rows, sbase_rows, tokens, start, n_valids, seed = \
+            args[-7:]
+        row = table_rows[0]
+        srow = stab_rows[0]
+        sbase = sbase_rows[0]
+        n_valid = n_valids[0]
+        posns = start + jnp.arange(C)                      # (C,)
+        x = params["wte"].astype(cfg.dtype)[tokens][None] \
+            + params["wpe"].astype(cfg.dtype)[posns][None]  # (1, C, E)
+        valid_i = jnp.arange(C) < n_valid
+        blk = jnp.where(valid_i, row[posns // bs], TRASH_BLOCK)
+        off = posns % bs
+        maxpos = (start + n_valid - 1)[None]             # (1,)
+        layout = jnp.asarray(_policy_layout(win, g, W) > 0)
+        qb = jnp.minimum(posns // bs, W - 1)               # (C,)
+        view_pos = sbase[:, None] + jnp.arange(bs)[None, :]
+        sblk = jnp.minimum(view_pos // bs, W - 1)          # (K, bs)
+        allow = layout[qb[:, None, None], sblk[None]] \
+            .reshape(C, K * bs)[None]                      # (1, C, K*bs)
+        x, pools = _paged_forward(
+            params, cfg, pools, row[None], posns, maxpos, blk, off, x,
+            quantized, sparse=(srow[None], sbase[None]), allowed=allow)
+        out = pools[:4] if quantized else pools[:2]
+        if not final:
+            return out
+        xe = jax.lax.dynamic_index_in_dim(x[0], n_valid - 1, 0,
+                                          keepdims=False)
+        logits = _lm_logits(params, cfg, xe[None])
+        finite = jnp.isfinite(logits).all(axis=-1)       # (1,)
+        nxt = _pick_next(logits, seed[None], (start + n_valid - 1)[None],
+                         temperature, top_k, top_p)
+        return (*out, nxt, finite)
+
+    n_pool = 4 if quantized else 2
+    return _shard_wrap(run, mesh, axis_name, n_pool,
+                       in_streams=(True, True, True, False, False, True,
+                                   False),
+                       n_out_streams=2 if final else 0)
+
+
 class InferenceEngine:
     """Continuous-batching serving engine (see module docstring).
 
@@ -333,7 +448,8 @@ class InferenceEngine:
                  policy="continuous", shards=1, mesh=None,
                  axis_name="data", watchdog=None, clock=time.monotonic,
                  reliability=None, telemetry=None, prefix_cache=False,
-                 speculative=None):
+                 speculative=None, sparse_context=None,
+                 prefill_fairness=0):
         cfg = model.config
         assert not getattr(cfg, "moe_num_experts", 0), \
             "InferenceEngine serves dense blocks only: chunked prefill " \
@@ -407,9 +523,6 @@ class InferenceEngine:
         self._active = np.zeros(S, bool)
         self._seeds = np.zeros(S, np.int32)
         self._poison = np.zeros(S, np.float32)
-        self._decode = _make_decode_step(
-            cfg, self.W, self.bs, self.pool.quantized, self.temperature,
-            self.top_k, self.top_p, mesh, axis_name)
         self.prefix_cache = self._arm_prefix_cache(prefix_cache,
                                                    quantize_kv)
         self._readmit_rids = set()
@@ -420,6 +533,32 @@ class InferenceEngine:
             self._spec = _make_spec_verify(
                 cfg, self.spec_k, self.W, self.bs, self.pool.quantized,
                 mesh, axis_name)
+        # sparse page attention (serving/sparse_context.py) arms AFTER
+        # speculation — draft-k is one of its DISARMED blockers — and
+        # picks which decode program the engine serves
+        self.sparse = self._arm_sparse_context(sparse_context)
+        self.prefill_fairness = int(prefill_fairness or 0)
+        if self.prefill_fairness and policy != "continuous":
+            logger.warning(
+                "prefill fairness: DISARMED — the static batch gate "
+                "already runs each batch to completion; the pause "
+                "quantum only applies to continuous batching.")
+            self.prefill_fairness = 0
+        self._stables = self._sbase = None
+        if self.sparse is not None:
+            self._stables = np.full((S, self.sparse.K), TRASH_BLOCK,
+                                    np.int32)
+            self._sbase = np.full((S, self.sparse.K),
+                                  int(self.sparse.sentinel), np.int32)
+            self._decode_name = "sparse_decode_step"
+            self._decode = _make_sparse_decode_step(
+                cfg, self.W, self.sparse.K, self.bs, self.pool.quantized,
+                self.temperature, self.top_k, self.top_p, mesh, axis_name)
+        else:
+            self._decode_name = "decode_step"
+            self._decode = _make_decode_step(
+                cfg, self.W, self.bs, self.pool.quantized,
+                self.temperature, self.top_k, self.top_p, mesh, axis_name)
 
     @property
     def program_registry(self):
@@ -502,6 +641,84 @@ class InferenceEngine:
                 "jit.", self.temperature)
             return 0
         return k
+
+    def _arm_sparse_context(self, spec):
+        """Sparse page attention (``sparse_context=`` as a policy dict,
+        an ``ops/sparse_attention`` SparsityConfig-style object, or a
+        prebuilt :class:`SparseContext`) arms only where the policy maps
+        soundly onto the paged pool — every blocked request warns
+        DISARMED naming the blocker and the engine serves the dense
+        decode jit instead (the armed-or-warns discipline).  Blockers:
+        a token window that is not a multiple of the pool block size
+        (the window edge would land mid-page), beam search (active-page
+        lists are single-hypothesis), draft-k speculation (the verify
+        jit gathers the full table — composing them is future work),
+        and non-prefix global anchors.  Returns the armed SparseContext
+        or None."""
+        if not spec:
+            return None
+        if self.spec_k:
+            logger.warning(
+                "sparse context: DISARMED — draft-k speculative decoding "
+                "is armed (draft_len=%d): the verify jit scores K+1 "
+                "query tokens against the FULL page table and its "
+                "acceptance rule assumes dense attention; composing the "
+                "two gather policies is not supported yet.  Serving "
+                "dense attention.", self.spec_k)
+            return None
+        if isinstance(spec, SparseContext):
+            if spec.bs != self.bs or spec.W != self.W:
+                logger.warning(
+                    "sparse context: DISARMED — the supplied "
+                    "SparseContext was compiled for block_size=%d/"
+                    "table_width=%d but this engine runs %d/%d; its LUT "
+                    "would address the wrong pages.  Serving dense "
+                    "attention.", spec.bs, spec.W, self.bs, self.W)
+                return None
+            return spec
+        if isinstance(spec, dict):
+            d = dict(spec)
+            beam = int(d.pop("beam_width", 1) or 1)
+            if beam > 1:
+                logger.warning(
+                    "sparse context: DISARMED — beam_width=%d > 1: beam "
+                    "lanes share pages under different hypotheses and "
+                    "the per-lane active-page lists are single-"
+                    "hypothesis.  Serving dense attention.", beam)
+                return None
+            wt = d.pop("window_tokens", None)
+            if wt is not None:
+                if int(wt) % self.bs != 0:
+                    logger.warning(
+                        "sparse context: DISARMED — window_tokens=%d is "
+                        "not a multiple of the KV block size %d: the "
+                        "policy's block granularity must BE the pool's "
+                        "block size or the window edge lands mid-page.  "
+                        "Round the window to a block multiple (e.g. %d "
+                        "or %d).  Serving dense attention.",
+                        int(wt), self.bs,
+                        (int(wt) // self.bs) * self.bs,
+                        (int(wt) // self.bs + 1) * self.bs)
+                    return None
+                d.setdefault("num_sliding_window_blocks",
+                             int(wt) // self.bs)
+            win = int(d.get("num_sliding_window_blocks", 0))
+            if win < 1:
+                logger.warning(
+                    "sparse context: DISARMED — num_sliding_window_"
+                    "blocks=%d < 1 cannot cover the query's own block.  "
+                    "Serving dense attention.", win)
+                return None
+            return SparseContext(block_size=self.bs, table_width=self.W,
+                                 **d)
+        try:
+            return SparseContext.from_sparsity_config(
+                spec, block_size=self.bs, table_width=self.W)
+        except ValueError as e:
+            logger.warning(
+                "sparse context: DISARMED — %s.  Serving dense "
+                "attention.", e)
+            return None
 
     def _arm_telemetry(self, spec):
         """Arm the serving telemetry session from the ``telemetry=``
@@ -618,7 +835,43 @@ class InferenceEngine:
                 deadline_s = rel_cfg.default_deadline_s
             if work_budget is None:
                 work_budget = rel_cfg.default_work_budget
+        if deadline_s is not None and float(deadline_s) <= 0:
+            raise ValueError(
+                f"deadline_s={deadline_s} is not a positive budget: the "
+                f"request would expire before its first step ever runs. "
+                f"Submit with deadline_s=None (no deadline) or a positive "
+                f"number of seconds.")
         rid = next(self._rids) if _rid is None else int(_rid)
+        if deadline_s is not None and not self._warming and not _readmit:
+            # deadline-impossible max_new: even PERFECT service — an
+            # empty queue, every step at the measured EMA — cannot fit
+            # the minimum step count inside the budget.  Reject at
+            # admission instead of burning prefill work that is
+            # guaranteed to expire mid-flight.  Strict lower bound only:
+            # a request feasible in isolation is never turned away here
+            # (queueing delay stays the reliability layer's call).
+            ema = self.metrics.step_time()
+            min_steps = -(-int(prompt.size) // self.prefill_chunk) \
+                + int(max_new_tokens)
+            if ema is not None and min_steps * ema > float(deadline_s):
+                logger.warning(
+                    "submit(rid=%d): deadline-impossible — prompt=%d "
+                    "tokens + max_new=%d needs >= %d engine steps; at "
+                    "the measured %.4fs/step that is a %.3fs zero-queue "
+                    "lower bound, over deadline_s=%.3f.  Rejected at "
+                    "admission (no prefill work wasted).  Raise "
+                    "deadline_s or shrink max_new_tokens.",
+                    rid, prompt.size, int(max_new_tokens), min_steps,
+                    ema, min_steps * ema, float(deadline_s))
+                self.results[rid] = {
+                    "tokens": prompt.copy(), "status": ABORT_EXPIRED,
+                    "evictions": 0,
+                }
+                self.metrics.record_finish(rid, ABORT_EXPIRED)
+                if self._tracer is not None:
+                    self._tracer.instant(f"abort_{ABORT_EXPIRED}",
+                                         self._lane_serve, a0=rid)
+                return rid
         req = Request(rid=rid, prompt=prompt,
                       max_new_tokens=int(max_new_tokens),
                       priority=int(priority), eos_token_id=eos_token_id,
@@ -630,7 +883,12 @@ class InferenceEngine:
             req.generated = [int(t) for t in _generated]
         if _work_done:
             req.work_done = int(_work_done)
-        self.metrics.record_submit(rid)
+        # TTFT class: "long" prompts (several prefill chunks) vs chatty
+        # "short" ones — the per-class view the long-context bench's
+        # fairness guard reads
+        self.metrics.record_submit(
+            rid, klass="long" if prompt.size >= 4 * self.prefill_chunk
+            else "short")
         if not self._warming:
             if _readmit:
                 # already-admitted work (recovery/migration): bypass the
@@ -732,6 +990,11 @@ class InferenceEngine:
                 self.metrics.prefill_computed_tokens,
             "tokens_per_verify": self.metrics.tokens_per_verify(),
             "spec_accept_hist": dict(self.metrics.spec_accept_hist),
+            # sparse page attention (ISSUE 20): scalars only, so the
+            # fleet's flattened replica_metrics carry them for free
+            "active_page_fraction": self.metrics.active_page_fraction(),
+            "window_expired_frees": self.metrics.window_expired_frees,
+            "short_ttft_p95": self.metrics.class_ttft_p95("short"),
         }
         if tr is not None:
             tr.complete("serving_step", self._lane_serve, _t0,
@@ -962,6 +1225,10 @@ class InferenceEngine:
         self._tok[slot] = req.generated[-1]
         self._seeds[slot] = req.seed
         self._active[slot] = True
+        if self.sparse is not None:
+            self._stables[slot], self._sbase[slot] = \
+                self.sparse.active_row(self._tables[slot],
+                                       int(self._pos[slot]))
         # journal directly (no admission gate: this work was admitted
         # once already); no metrics.record_submit — TTFT stays at the
         # replica that admitted it
@@ -1046,6 +1313,9 @@ class InferenceEngine:
             "top_p": self.top_p,
             "prefix_cache": self.prefix_cache,
             "speculative_draft_len": self.spec_k,
+            "sparse_context": self.sparse.describe()
+            if self.sparse is not None else None,
+            "prefill_fairness": self.prefill_fairness,
         }
         rep["kv_pool"]["now"] = self.pool.stats()
         rep["reliability"] = self.reliability.report()
@@ -1123,12 +1393,22 @@ class InferenceEngine:
             analytic=analytic, accounting=self._memacct, devices=devices,
             extra={"engine": type(self).__name__})
 
+    def _decode_args(self):
+        """Full argument tuple of the armed decode program (dense or
+        sparse) — shared by dispatch, program registration, telemetry
+        shape capture and :meth:`decode_hlo`."""
+        if self.sparse is not None:
+            return (self.params, *self.pool.tensors.arrays, self._tables,
+                    self._stables, self._sbase, self._pos, self._tok,
+                    self._active, self._seeds, self._poison)
+        return (self.params, *self.pool.tensors.arrays, self._tables,
+                self._pos, self._tok, self._active, self._seeds,
+                self._poison)
+
     def decode_hlo(self) -> str:
         """Compiled HLO of the decode program (for the graftlint HLO
         contracts: host-transfer-free, pool donated, zero collectives)."""
-        args = (self.params, *self.pool.tensors.arrays, self._tables,
-                self._pos, self._tok, self._active, self._seeds,
-                self._poison)
+        args = self._decode_args()
         return self._decode.lower(*args).compile().as_text()
 
     def spec_hlo(self) -> str:
@@ -1232,6 +1512,9 @@ class InferenceEngine:
         self._tables[slot] = TRASH_BLOCK
         self._pos[slot] = 0
         self._tok[slot] = 0
+        if self.sparse is not None:
+            self._stables[slot] = TRASH_BLOCK
+            self._sbase[slot] = int(self.sparse.sentinel)
 
     def _cleanup(self, req, reason):
         self.pool.free(req.rid)
@@ -1307,10 +1590,17 @@ class InferenceEngine:
         req = sch.prefilling
         if req is None:
             req = sch.start_admission()
+            if req is not None:
+                req.shard = self._shard_for_slot(req.slot)
+                events["admitted"].append(req.rid)
+            else:
+                # no fresh admission (empty queue or no free slot): give
+                # the lane back to the oldest fairness-paused prefill.
+                # Trying admissions FIRST is what makes the quantum
+                # round-robin — a paused giant never starves newcomers.
+                req = sch.resume_prefill()
             if req is None:
                 return
-            req.shard = self._shard_for_slot(req.slot)
-            events["admitted"].append(req.rid)
         toks = req.full_tokens
         total = len(toks)
         start = req.prefill_done
@@ -1320,24 +1610,55 @@ class InferenceEngine:
         if not self._ensure_blocks(req, start + n + (1 if final else 0),
                                    admission=True, events=events):
             return
+        if self.sparse is not None:
+            # blocks below the chunk's FIRST query window (keeping the
+            # global anchors) are already unreachable — free them before
+            # building the table row, exactly like the decode tick
+            freed = self.pool.window_expired_free(
+                req.rid, self.sparse.first_active_block(start),
+                keep_blocks=self.sparse.g)
+            if freed:
+                self.metrics.record_window_expired(freed)
         bucket = self._bucket(n)
         tok_pad = np.zeros(bucket, np.int32)
         tok_pad[:n] = toks[start:start + n]
-        fn = _make_prefill_chunk(
-            self.cfg, bucket, self.W, self.bs, self.pool.quantized, final,
-            self.temperature, self.top_k, self.top_p, self.mesh,
-            self.axis_name)
         rows, nv = self._prefill_args(req, n)
-        pf_name = f"prefill_chunk{bucket}" + ("_final" if final else "")
-        pf_args = (self.params, *self.pool.tensors.arrays, rows,
-                   tok_pad, np.int32(start), nv, np.int32(req.seed))
+        if self.sparse is not None:
+            K_pf = self.sparse.prefill_K(bucket)
+            fn = _make_sparse_prefill_chunk(
+                self.cfg, bucket, self.W, K_pf, self.bs, self.sparse.win,
+                self.sparse.g, self.pool.quantized, final,
+                self.temperature, self.top_k, self.top_p, self.mesh,
+                self.axis_name)
+            srows = np.full((self.shards, K_pf), TRASH_BLOCK, np.int32)
+            sbases = np.full((self.shards, K_pf),
+                             int(self.sparse.sentinel), np.int32)
+            srows[req.shard], sbases[req.shard] = \
+                self.sparse.prefill_active_row(rows[req.shard], start, n,
+                                               bucket)
+            pf_name = f"sparse_prefill_chunk{bucket}" \
+                + ("_final" if final else "")
+            pf_args = (self.params, *self.pool.tensors.arrays, rows,
+                       srows, sbases, tok_pad, np.int32(start), nv,
+                       np.int32(req.seed))
+            group = "serving:sparse_prefill_final" if final \
+                else "serving:sparse_prefill"
+        else:
+            fn = _make_prefill_chunk(
+                self.cfg, bucket, self.W, self.bs, self.pool.quantized,
+                final, self.temperature, self.top_k, self.top_p,
+                self.mesh, self.axis_name)
+            pf_name = f"prefill_chunk{bucket}" + ("_final" if final
+                                                  else "")
+            pf_args = (self.params, *self.pool.tensors.arrays, rows,
+                       tok_pad, np.int32(start), nv, np.int32(req.seed))
+            group = "serving:prefill_final" if final \
+                else "serving:prefill"
         # bucketed prefill programs at the same schedule slot must post
         # identical collective sequences (uniform_group) — a divergence
         # between buckets would deadlock a multi-host SPMD dispatch
-        self._register_serving_program(
-            pf_name, fn, pf_args,
-            uniform_group="serving:prefill_final" if final
-            else "serving:prefill")
+        self._register_serving_program(pf_name, fn, pf_args,
+                                       uniform_group=group)
         if self.telemetry is not None:
             # every bucketed prefill jit joins the MFU + memory ledgers
             # (capture-by-shape, no-op after the first registration)
@@ -1346,8 +1667,7 @@ class InferenceEngine:
 
             register_by_shape(self.telemetry.mfu, pf_name, fn, pf_args)
             mem_acc.register_by_shape(self._memacct, pf_name, fn, pf_args)
-        out = fn(self.params, *self.pool.tensors.arrays, rows, tok_pad,
-                 np.int32(start), nv, np.int32(req.seed))
+        out = fn(*pf_args)
         req.work_done += n
         self.metrics.record_prefill(n)
         if final:
@@ -1370,6 +1690,15 @@ class InferenceEngine:
         else:
             self._rebind(out)
             req.prefill_done = start + n
+            if self.prefill_fairness:
+                # chunked-prefill fairness: after a quantum of chunks a
+                # huge prompt yields the lane IF anyone is waiting for
+                # it — chatty short requests interleave instead of
+                # queueing behind the whole giant
+                req.fair_chunks += 1
+                if req.fair_chunks >= self.prefill_fairness and \
+                        (sch.peek_waiting() is not None or sch.paused):
+                    sch.pause_prefill(req)
 
     def _draft_tokens(self, req, k):
         """Host-side n-gram drafter: propose the continuation that
@@ -1431,6 +1760,9 @@ class InferenceEngine:
             toks_in[slot, 1:] = drafts
             self._drafts[slot] = drafts
             req.work_done += n
+        self.metrics.record_gather(
+            len(running), len(running) * self.W, len(running) * self.W,
+            sum(self.pool.blocks_of(r.rid) for r in running.values()))
         tel = self.telemetry
         spec_args = (self.params, *self.pool.tensors.arrays,
                      self._tables, self._pos, toks_in, nvalid,
@@ -1508,30 +1840,56 @@ class InferenceEngine:
             self._poison[victim.slot] = np.nan
             chaos.record_serving_poison(victim.rid)
         for slot, req in running.items():
+            if self.sparse is not None:
+                # pages below every remaining query's window (keeping
+                # the global anchors) can never be gathered again —
+                # return them to the allocator before refreshing the
+                # table row, so this step's row already shows the holes
+                freed = self.pool.window_expired_free(
+                    req.rid,
+                    self.sparse.first_active_block(int(self._pos[slot])),
+                    keep_blocks=self.sparse.g)
+                if freed:
+                    self.metrics.record_window_expired(freed)
             self._tables[slot] = self.pool.table_row(req.rid, self.W)
+            if self.sparse is not None:
+                # host-side LUT maintenance: same no-mutation-before-
+                # fetch discipline as _pos/_tok (the previous dispatch's
+                # batched fetch already completed)
+                self._stables[slot], self._sbase[slot] = \
+                    self.sparse.active_row(self._tables[slot],
+                                           int(self._pos[slot]))
             req.work_done += 1
+        lanes = len(running)
+        if self.sparse is not None:
+            nonpad = int(sum(
+                (self._sbase[slot] != int(self.sparse.sentinel)).sum()
+                for slot in running))
+            self.metrics.record_gather(lanes, lanes * self.sparse.K,
+                                       lanes * self.W, nonpad)
+        else:
+            self.metrics.record_gather(
+                lanes, lanes * self.W, lanes * self.W,
+                sum(self.pool.blocks_of(r.rid) for r in running.values()))
         tel = self.telemetry
         # capture-by-shape BEFORE dispatch (the pool is donated by it);
         # the lower+compile runs lazily at report/lint time, outside any
         # recompile-guard window
-        decode_args = (self.params, *self.pool.tensors.arrays,
-                       self._tables, self._pos, self._tok,
-                       self._active, self._seeds, self._poison)
-        self._register_serving_program("decode_step", self._decode,
+        decode_args = self._decode_args()
+        self._register_serving_program(self._decode_name, self._decode,
                                        decode_args)
         if tel is not None:
             from deepspeed_tpu.runtime import memory_accounting as mem_acc
             from deepspeed_tpu.telemetry import register_by_shape
 
-            register_by_shape(tel.mfu, "decode_step", self._decode,
+            register_by_shape(tel.mfu, self._decode_name, self._decode,
                               decode_args)
             mem_acc.register_by_shape(
-                self._memacct, "decode_step", self._decode, decode_args,
+                self._memacct, self._decode_name, self._decode,
+                decode_args,
                 expect_label="serving decode step: donated-in-place KV "
                 "block pool + sampled tokens")
-        out = self._decode(self.params, *self.pool.tensors.arrays,
-                           self._tables, self._pos, self._tok,
-                           self._active, self._seeds, self._poison)
+        out = self._decode(*decode_args)
         self._rebind(out[:-2])
         # kill-mid-decode chaos: the dispatch happened, NO host
         # bookkeeping has — the journal holds the last committed step

@@ -46,6 +46,17 @@ blocks are returned to the free list only when BOTH every mapping
 request has freed them AND the cache reclaims the node (LRU,
 unreferenced leaves first) — eviction never touches a block a live
 request still maps, and the trash block (0) is never cached.
+
+Window-expired reclamation (serving/sparse_context.py): under a
+sliding-window attention policy, pages below every remaining query's
+window can never be gathered again — :meth:`window_expired_free`
+returns those PRIVATE blocks to the allocator early, recording the gap
+as a ``None`` hole in the page table so logical position ↔ list index
+stays intact (``table_row`` maps holes to the trash block; the sparse
+gather's sentinel positions mask them).  Tree-owned blocks are NEVER
+window-freed: the prefix cache's refcounts outrank the window policy,
+so a shared prefix stays resident for the requests (and the tree) that
+still hold it.
 """
 import functools
 from typing import Dict, List, NamedTuple, Optional
@@ -183,6 +194,7 @@ class PagedKVPool:
         self._tick = 0
         self.cow_splits = 0
         self.cache_reclaims = 0
+        self.window_frees = 0      # blocks early-freed by window expiry
 
     # -- arming ---------------------------------------------------------
     def _arm_quantized_kv(self, requested):
@@ -245,12 +257,43 @@ class PagedKVPool:
         nodes = self._nodes[shard]
         recycled = []
         for b in blocks:
+            if b is None:             # window-expired hole, already freed
+                continue
             node = nodes.get(b) if b in shared else None
             if node is not None:
                 node.refs -= 1
             else:
                 recycled.append(b)
         self._free[shard] = sorted(self._free[shard] + recycled)
+
+    def window_expired_free(self, rid: int, first_active_block: int, *,
+                            keep_blocks: int = 0) -> int:
+        """Early-free the PRIVATE blocks of ``rid`` whose logical index
+        has fallen below ``first_active_block`` — under a sliding-window
+        policy no remaining query can ever gather them again.  The first
+        ``keep_blocks`` logical blocks (the policy's global anchors) are
+        always kept.  Freed slots become ``None`` holes so the page
+        table keeps its positional indexing; tree-owned (prefix-shared)
+        blocks are SKIPPED, refs untouched — the radix tree's ownership
+        outranks the window.  Returns the number of blocks freed."""
+        blocks = self._blocks.get(rid)
+        if not blocks:
+            return 0
+        shard = self._shard_of[rid]
+        shared = set(self._shared.get(rid, ()))
+        nodes = self._nodes[shard]
+        hi = min(int(first_active_block), len(blocks))
+        recycled = []
+        for i in range(max(0, int(keep_blocks)), hi):
+            b = blocks[i]
+            if b is None or b in shared or b in nodes:
+                continue
+            blocks[i] = None
+            recycled.append(b)
+        if recycled:
+            self._free[shard] = sorted(self._free[shard] + recycled)
+            self.window_frees += len(recycled)
+        return len(recycled)
 
     def _drop(self, rid):
         self._blocks.pop(rid, None)
@@ -260,12 +303,15 @@ class PagedKVPool:
 
     def table_row(self, rid: int, width: int) -> np.ndarray:
         """LOCAL block ids of ``rid`` padded with the trash block to the
-        fixed table width (the decode jit's static W)."""
+        fixed table width (the decode jit's static W).  Window-expired
+        holes (``None``) map to the trash block too — their positions
+        are masked out by the policy before they could be gathered."""
         blocks = self._blocks.get(rid, [])
         assert len(blocks) <= width, \
             f"rid {rid} holds {len(blocks)} blocks > table width {width}"
         row = np.full(width, TRASH_BLOCK, np.int32)
-        row[:len(blocks)] = blocks
+        row[:len(blocks)] = [TRASH_BLOCK if b is None else b
+                             for b in blocks]
         return row
 
     def global_table_row(self, rid: int, width: int) -> np.ndarray:
@@ -286,8 +332,9 @@ class PagedKVPool:
 
     def blocks_of(self, rid: int) -> int:
         """Blocks currently allocated to ``rid`` (0 when unknown) — the
-        payload size a KV handoff of this request would transfer."""
-        return len(self._blocks.get(rid, ()))
+        payload size a KV handoff of this request would transfer.
+        Window-expired holes no longer hold pool capacity."""
+        return sum(1 for b in self._blocks.get(rid, ()) if b is not None)
 
     # -- prefix cache (copy-on-write shared blocks) ---------------------
     def _touch(self, node):
@@ -379,6 +426,9 @@ class PagedKVPool:
                 self._touch(node)
             else:
                 blk = blocks[i]
+                if blk is None:       # window-expired hole: the KV
+                    break             # content is gone, nothing past it
+                                      # can be published
                 if blk in nodes:      # block published by an earlier
                     break             # insert of this rid under another
                                       # key — never double-own a block
@@ -491,13 +541,16 @@ class PagedKVPool:
         covered by live tokens (tail slack of each sequence's last
         block).  Shared blocks appear once per mapping request on both
         sides of the ratio, so this stays a pure slack measure under
-        prefix sharing.  0 = every mapped slot holds a token."""
-        allocated = sum(len(b) for b in self._blocks.values()) \
-            * self.block_size
+        prefix sharing.  0 = every mapped slot holds a token.  Clamped
+        at 0: window-expired frees can leave more live positions than
+        mapped slots (the freed tokens are no longer resident)."""
+        allocated = sum(
+            sum(1 for blk in b if blk is not None)
+            for b in self._blocks.values()) * self.block_size
         if allocated == 0:
             return 0.0
         used = sum(self._positions.values())
-        return 1.0 - used / allocated
+        return max(0.0, 1.0 - used / allocated)
 
     def stats(self) -> dict:
         return {
@@ -515,4 +568,5 @@ class PagedKVPool:
                 n.refs for nodes in self._nodes for n in nodes.values()),
             "prefix_cow_splits": self.cow_splits,
             "prefix_cache_reclaims": self.cache_reclaims,
+            "window_expired_frees": self.window_frees,
         }

@@ -122,17 +122,36 @@ class ServingMetrics:
         self.spec_verify_steps = 0         # verify dispatches (lane-steps)
         self.spec_accepted_tokens = 0      # tokens delivered by verifies
         self.spec_accept_hist: Dict[int, int] = {}  # accepted-length counts
+        # sparse page attention (ISSUE 20): per-dispatch gather accounting
+        self.sparse_gathered_pages = 0     # pages the jits actually gather
+        self.sparse_dense_pages = 0        # what dense gathering would cost
+        self.sparse_active_pages = 0       # non-padded entries (policy live)
+        self.sparse_lane_steps = 0         # decode lanes the gathers served
+        self.window_expired_frees = 0      # blocks early-freed by the window
+        # per-class TTFT (long vs short under long-context contention)
+        self._class_of: Dict[int, str] = {}
+        self.ttft_by_class: Dict[str, List[float]] = {}
 
     # -- request lifecycle ---------------------------------------------
-    def record_submit(self, rid):
+    def record_submit(self, rid, klass=None):
+        """``klass`` (e.g. "short"/"long" by prompt length) buckets this
+        request's eventual TTFT sample — the per-class view is how the
+        long-context bench proves chatty short requests keep their
+        latency while huge prompts prefill."""
         self._arrival[rid] = self._clock()
+        if klass is not None:
+            self._class_of[rid] = str(klass)
 
     def record_token(self, rid):
         now = self._clock()
         if rid not in self._first_token:
             self._first_token[rid] = now
             if rid in self._arrival:
-                self.ttft.append(now - self._arrival[rid])
+                sample = now - self._arrival[rid]
+                self.ttft.append(sample)
+                klass = self._class_of.get(rid)
+                if klass is not None:
+                    self.ttft_by_class.setdefault(klass, []).append(sample)
         self._last_token[rid] = now
         self._tokens[rid] = self._tokens.get(rid, 0) + 1
         self.total_tokens += 1
@@ -188,6 +207,39 @@ class ServingMetrics:
         self.spec_accepted_tokens += int(accepted)
         self.spec_accept_hist[int(accepted)] = \
             self.spec_accept_hist.get(int(accepted), 0) + 1
+
+    def record_gather(self, lanes, gathered_pages, dense_pages,
+                      active_pages=None):
+        """One decode dispatch's KV gather bill: ``gathered_pages`` is
+        what the jit actually pulled (lanes × K under a sparse policy,
+        lanes × W dense), ``dense_pages`` what the dense path would have
+        pulled for the same lanes — the A/B numerator/denominator of the
+        ≥4x acceptance gate.  ``active_pages`` counts the non-padded
+        entries (pages the policy genuinely needs)."""
+        self.sparse_lane_steps += int(lanes)
+        self.sparse_gathered_pages += int(gathered_pages)
+        self.sparse_dense_pages += int(dense_pages)
+        if active_pages is not None:
+            self.sparse_active_pages += int(active_pages)
+
+    def record_window_expired(self, n_blocks):
+        """Blocks the pool early-freed because they fell below every
+        remaining query's sliding window."""
+        self.window_expired_frees += int(n_blocks)
+
+    def class_ttft_p95(self, klass):
+        """p95 TTFT of one request class (None before its first token —
+        honest gap, not 0)."""
+        xs = self.ttft_by_class.get(klass)
+        return _pct(xs, .95) if xs else None
+
+    def active_page_fraction(self):
+        """Gathered pages as a fraction of the dense-equivalent gather
+        (1.0 = dense, 1/K-ish under an effective window).  None before
+        the first recorded gather (honest gap, not 0)."""
+        if not self.sparse_dense_pages:
+            return None
+        return self.sparse_gathered_pages / self.sparse_dense_pages
 
     def tokens_per_verify(self):
         """Mean tokens delivered per speculative verify dispatch (the
@@ -321,6 +373,21 @@ class ServingMetrics:
                 "tokens_per_verify": self.tokens_per_verify(),
                 "accept_len_hist": dict(sorted(
                     self.spec_accept_hist.items())),
+            },
+            "sparse_context": {
+                "gathered_pages": self.sparse_gathered_pages,
+                "dense_equivalent_pages": self.sparse_dense_pages,
+                "active_page_fraction": self.active_page_fraction(),
+                "gathered_pages_per_lane_step":
+                    (self.sparse_gathered_pages / self.sparse_lane_steps)
+                    if self.sparse_lane_steps else None,
+                "active_pages_per_lane_step":
+                    (self.sparse_active_pages / self.sparse_lane_steps)
+                    if self.sparse_lane_steps else None,
+                "window_expired_frees": self.window_expired_frees,
+                "ttft_by_class": {
+                    k: {"n": len(v), "mean": _mean(v), "p95": _pct(v, .95)}
+                    for k, v in sorted(self.ttft_by_class.items())},
             },
             "queue_depth": {"mean": self._queue_depth.mean(),
                             "max": self._queue_depth.max()

@@ -74,6 +74,7 @@ class Request:
     submit_seq: int = -1
     evictions: int = 0
     work_done: int = 0                 # token-writes scheduled so far
+    fair_chunks: int = 0               # chunks since last fairness pause
     finish_reason: Optional[str] = None
 
     @property
@@ -108,6 +109,11 @@ class Scheduler:
         self.requests: Dict[int, Request] = {}    # every live request
         self.running: Dict[int, Request] = {}     # slot -> Request
         self.prefilling: Optional[Request] = None
+        # chunked-prefill fairness (long-context traffic): a huge prompt
+        # mid-prefill can be PAUSED — it keeps its slot, blocks and
+        # prefill_done, and waits here FIFO while shorter prompts take a
+        # turn.  Distinct from _requeue, which resets prefill progress.
+        self.paused: List[Request] = []
         # static-policy batch gate: a batch's MEMBERSHIP is fixed when it
         # forms — the budget stops freed lanes from being refilled until
         # the whole batch drains (that refill IS continuous batching)
@@ -170,15 +176,18 @@ class Scheduler:
         if self.prefilling is not None:
             toks += len(self.prefilling.full_tokens) \
                 - self.prefilling.prefill_done
+        for r in self.paused:
+            toks += len(r.full_tokens) - r.prefill_done
         return toks
 
     def has_work(self) -> bool:
         return bool(self.running) or self.prefilling is not None \
-            or self.queue_depth() > 0
+            or bool(self.paused) or self.queue_depth() > 0
 
     def in_flight(self) -> bool:
         """Admitted work only (what a graceful drain must finish)."""
-        return bool(self.running) or self.prefilling is not None
+        return bool(self.running) or self.prefilling is not None \
+            or bool(self.paused)
 
     # -- slots ----------------------------------------------------------
     # the engine installs a ranker so admission steers toward the slot
@@ -197,6 +206,9 @@ class Scheduler:
         taken = set(self.running)
         if self.prefilling is not None and self.prefilling.slot is not None:
             taken.add(self.prefilling.slot)
+        for p in self.paused:      # paused prefills keep their slot
+            if p.slot is not None:
+                taken.add(p.slot)
         free = [s for s in range(self.max_slots) if s not in taken]
         if not free:
             return None
@@ -278,6 +290,28 @@ class Scheduler:
         if requeue:
             self._requeue(req)
 
+    # -- chunked-prefill fairness ---------------------------------------
+    def pause_prefill(self, req: Request) -> None:
+        """Yield the prefill lane mid-prompt: the request keeps its slot,
+        pool blocks and ``prefill_done`` (no recompute — unlike
+        preemption) and joins the paused FIFO; the lane is free for a
+        shorter prompt's turn.  The fairness quantum in the engine
+        decides when this fires."""
+        assert req is self.prefilling
+        self.prefilling = None
+        req.fair_chunks = 0
+        self.paused.append(req)
+
+    def resume_prefill(self) -> Optional[Request]:
+        """Resume the oldest paused prefill (FIFO) when the lane is
+        idle.  The engine calls this AFTER trying fresh admissions, so
+        paused giants and queued newcomers round-robin the lane."""
+        if self.prefilling is not None or not self.paused:
+            return None
+        req = self.paused.pop(0)
+        self.prefilling = req
+        return req
+
     # -- eviction / completion ------------------------------------------
     def victim(self, *, for_req: Request, admission: bool,
                shard: Optional[int] = None) -> Optional[Request]:
@@ -312,6 +346,8 @@ class Scheduler:
             del self.running[req.slot]
         if req is self.prefilling:
             self.prefilling = None
+        if req in self.paused:
+            self.paused.remove(req)
         # every terminal-without-completing reason (cancelled, and the
         # reliability layer's expired/budget/shed/poisoned) lands in the
         # CANCELLED state; only "finished" means the request completed

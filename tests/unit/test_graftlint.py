@@ -1796,3 +1796,138 @@ def test_host_sync_flags_zeroone_rearm_in_hot_fn():
     assert rule_names(got) == ["host-sync", "host-sync"]
     assert "arming time" in got[0].message
     assert lint(HS_REARM_GOOD, path, rules=["host-sync"]) == []
+
+
+# ---------------------------------------------------------------------------
+# sparse page attention (ISSUE 20): LUT walk hot, arming cold + disarmed
+# ---------------------------------------------------------------------------
+
+HS_ACTIVE_ROW_BAD = """
+class SparseContext:
+    def active_row(self, table_row, pos):
+        qb = min(int(pos) // self.bs, self.W - 1)
+        phys = [int(jax.device_get(table_row[max(b, 0)]))
+                for b in self.lut[qb]]
+        return phys, self.lut[qb] * self.bs
+"""
+
+HS_WINDOW_FREE_BAD = """
+class PagedKVPool:
+    def window_expired_free(self, rid, first_active_block, keep_blocks=0):
+        for i in range(keep_blocks, first_active_block):
+            b = self._blocks[rid][i]
+            if float(jax.device_get(self.tensors.k[0, b]).sum()) == 0:
+                continue
+            self._blocks[rid][i] = None
+"""
+
+HS_SPARSE_GOOD = """
+class SparseContext:
+    def active_row(self, table_row, pos):
+        qb = min(int(pos) // self.bs, self.W - 1)
+        row = self.lut[qb]
+        phys = table_row[np.maximum(row, 0)].astype(np.int32)
+        live = (row >= 0) & (phys != TRASH_BLOCK)
+        return (np.where(live, phys, 0),
+                np.where(live, row * self.bs, self.sentinel))
+
+    def prefill_active_row(self, table_row, start, n, bucket):
+        row = self.lut[min(int(start) // self.bs, self.W - 1)]
+        return table_row[np.maximum(row, 0)], row * self.bs
+"""
+
+
+@pytest.mark.parametrize("src,path,label", [
+    (HS_ACTIVE_ROW_BAD, "deepspeed_tpu/serving/sparse_context.py",
+     "active_row"),
+    (HS_WINDOW_FREE_BAD, "deepspeed_tpu/serving/kv_cache.py",
+     "window_expired_free"),
+])
+def test_host_sync_covers_sparse_lut_walk(src, path, label):
+    """ISSUE 20 satellite: the per-lane LUT walk and the window-expired
+    sweep run once per decode dispatch over every running lane — a
+    device fetch per lane (or per candidate block) serializes decode
+    against the host and fires; the pure-numpy row refresh is quiet."""
+    got = lint(src, path, rules=["host-sync"])
+    assert rule_names(got) == ["host-sync"], (label, path)
+    # scoped to the hot files: the same walk elsewhere is free
+    assert lint(src, "tests/unit/t.py", rules=["host-sync"]) == []
+
+
+def test_host_sync_sparse_row_refresh_quiet():
+    assert lint(HS_SPARSE_GOOD, "deepspeed_tpu/serving/sparse_context.py",
+                rules=["host-sync"]) == []
+
+
+HS_SPARSE_REARM_BAD = """
+class InferenceEngine:
+    def _decode_tick(self, events):
+        sparse = self._arm_sparse_context(self._sparse_spec)
+        sparse._compile_luts()
+        return self._decode(*self._decode_args())
+"""
+
+HS_SPARSE_REARM_GOOD = """
+class InferenceEngine:
+    def __init__(self, spec):
+        self.sparse = self._arm_sparse_context(spec)
+
+    def _decode_tick(self, events):
+        return self._decode(*self._decode_args())
+"""
+
+
+def test_host_sync_flags_sparse_rearm_in_hot_fn():
+    """Arming the policy (blocker scan + (W, K) LUT compile) is cold
+    -builder work: re-arming per decode tick rebuilds the LUTs and the
+    DISARMED decision every step and fires; arm-once at engine build is
+    quiet."""
+    path = "deepspeed_tpu/serving/engine.py"
+    got = lint(HS_SPARSE_REARM_BAD, path, rules=["host-sync"])
+    assert rule_names(got) == ["host-sync", "host-sync"]
+    assert "arming time" in got[0].message
+    assert lint(HS_SPARSE_REARM_GOOD, path, rules=["host-sync"]) == []
+
+
+DISARM_SPARSE_BAD = """
+class InferenceEngine:
+    def _arm_sparse_context(self, spec):
+        if not spec:
+            return None
+        if self.spec_k:
+            return None
+        if int(spec.get("window_tokens", 0)) % self.bs != 0:
+            return None
+        return SparseContext(block_size=self.bs, table_width=self.W)
+"""
+
+DISARM_SPARSE_GOOD = """
+class InferenceEngine:
+    def _arm_sparse_context(self, spec):
+        if not spec:
+            return None
+        if self.spec_k:
+            logger.warning("sparse context: DISARMED - draft-k "
+                           "speculation gathers the full table; "
+                           "composing the policies is unsupported")
+            return None
+        if int(spec.get("window_tokens", 0)) % self.bs != 0:
+            logger.warning("sparse context: DISARMED - window_tokens "
+                           "is not a multiple of the KV block size; "
+                           "the window edge would land mid-page")
+            return None
+        return SparseContext(block_size=self.bs, table_width=self.W)
+"""
+
+
+def test_disarmed_discipline_covers_sparse_context_arming():
+    """ISSUE 20 satellite: _arm_sparse_context follows the armed-or-
+    warns discipline — silently serving dense when a sparse policy was
+    requested fires; DISARMED warns naming the blocker (speculation,
+    mid-page window edge) are quiet."""
+    path = "deepspeed_tpu/serving/engine.py"
+    got = lint(DISARM_SPARSE_BAD, path, rules=["disarmed-discipline"])
+    assert rule_names(got) == ["disarmed-discipline"]
+    assert "_arm_sparse_context" in got[0].message
+    assert lint(DISARM_SPARSE_GOOD, path,
+                rules=["disarmed-discipline"]) == []

@@ -64,6 +64,8 @@ EXPECTED_PROGRAMS = {
     "serving": {"decode_step", "prefill_chunk8_final"},
     "serving-spec": {"cow_copy", "prefill_chunk4_final", "prefill_chunk8",
                      "spec_verify"},
+    "serving-sparse": {"sparse_decode_step", "sparse_prefill_chunk8",
+                       "sparse_prefill_chunk4_final"},
 }
 
 
@@ -111,7 +113,8 @@ def test_lower_error_is_a_finding_not_a_crash():
 def test_build_corpus_rejects_unknown_engine():
     with pytest.raises(ValueError, match="no-such-engine"):
         build_corpus(only=["no-such-engine"])
-    assert set(EXPECTED_PROGRAMS) - {"serving-spec"} == set(CORPUS_BUILDERS)
+    assert (set(EXPECTED_PROGRAMS) - {"serving-spec", "serving-sparse"}
+            == set(CORPUS_BUILDERS))
 
 
 # ---------------------------------------------------------------------------
@@ -395,3 +398,14 @@ def test_programs_autopilot_corpus_is_clean_and_complete():
     # 10. serving decode: batch-sharded, collective-free, pool donated
     c = contract("serving", "decode_step")
     assert c["collective_free"] and c["donates"] == [28, 29]
+    # 11. sparse page attention (ISSUE 20): same pool-donation contract
+    # as dense decode (pools sit at the same flat arg slots — the extra
+    # stables/sbase operands ride AFTER the tables), and the bucketed
+    # sparse prefills stay shape-uniform within their group
+    c = contract("serving-sparse", "sparse_decode_step")
+    assert c["collective_free"] and c["host_transfer_free"] \
+        and c["donates"] == [28, 29]
+    assert contract("serving-sparse", "sparse_prefill_chunk8")[
+        "uniform_group"] == "serving:sparse_prefill"
+    assert contract("serving-sparse", "sparse_prefill_chunk4_final")[
+        "uniform_group"] == "serving:sparse_prefill_final"

@@ -1,0 +1,621 @@
+"""Sparse page attention for the paged KV pool (ISSUE 20).
+
+The three load-bearing acceptance properties:
+
+- **Bit-identity escape hatch**: a window covering the whole table
+  (``globals + window >= W``) makes the sparse decode/prefill jits
+  gather exactly the dense page view — greedy tokens are BIT-IDENTICAL
+  to the dense engine and to single-sequence ``generate()``.
+- **Reference parity**: the policy's per-lane active rows, expanded to
+  token granularity, equal the XLA ``layout_to_token_mask`` reference
+  over ``SparseContext.layout()`` (the ops/sparse_attention mask path)
+  for every query position — decode AND chunked prefill.
+- **Zero-recompile pin**: with sparse armed, admission/finish churn
+  across >= 20 decode steps compiles NOTHING after warmup — fixed K
+  keeps the sparse jits inside the one-compile-per-program contract.
+
+Plus the satellites: window-expired reclamation composing with
+prefix-cache refcounts, admission validation, and chunked-prefill
+fairness.
+"""
+import logging
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.models.generation import generate
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+    layout_to_token_mask)
+from deepspeed_tpu.runtime import comm_accounting as ca
+from deepspeed_tpu.runtime import memory_accounting as ma
+from deepspeed_tpu.serving.engine import InferenceEngine
+from deepspeed_tpu.serving.kv_cache import TRASH_BLOCK, PagedKVPool
+from deepspeed_tpu.serving.metrics import CompilationCounter
+from deepspeed_tpu.serving.reliability import ABORT_EXPIRED
+from deepspeed_tpu.serving.sparse_context import (SparseContext,
+                                                  _policy_layout)
+from deepspeed_tpu.utils.logging import logger as ds_logger
+
+
+@pytest.fixture(scope="module")
+def toy():
+    cfg = GPT2Config(vocab_size=97, n_positions=64, n_embd=32, n_layer=2,
+                     n_head=4, dtype=jnp.float32, loss_chunk_tokens=0)
+    model = GPT2Model(cfg)
+    ids = np.random.default_rng(0).integers(0, 97, (2, 8))
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": ids, "labels": ids})
+    refs = {}
+
+    def ref(prompt, max_new):
+        key = (tuple(int(t) for t in prompt), max_new)
+        if key not in refs:
+            refs[key] = generate(model, params,
+                                 np.asarray(prompt, np.int32)[None],
+                                 max_new_tokens=max_new)[0]
+        return refs[key]
+
+    return model, params, ref
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("kv_block_size", 4)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("max_blocks_per_seq", 16)
+    return InferenceEngine(model, params, **kw)
+
+
+def _prompts(seed, lens):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(0, 97, n).astype(np.int32) for n in lens]
+
+
+# ---------------------------------------------------------------------------
+# policy compilation (LUTs, layout, active rows)
+# ---------------------------------------------------------------------------
+
+def test_lut_shape_and_causal_clipping():
+    p = SparseContext(block_size=4, table_width=8,
+                      num_sliding_window_blocks=3, num_global_blocks=2)
+    assert p.K == 5 and p.lut.shape == (8, 5)
+    # query block 0: only itself (global 0 IS block 0); pads are -1
+    assert p.lut[0].tolist() == [0, -1, -1, -1, -1]
+    # query block 1: both visible globals + itself, no duplicates
+    assert p.lut[1].tolist() == [0, 1, -1, -1, -1]
+    # deep query block: globals [0, 1] + window [5, 6, 7], ascending
+    assert p.lut[7].tolist() == [0, 1, 5, 6, 7]
+    # every row: sorted, unique, within range, causally clipped
+    for qb in range(8):
+        act = [b for b in p.lut[qb] if b >= 0]
+        assert act == sorted(set(act)) and all(0 <= b <= qb for b in act)
+
+
+def test_full_window_K_clamps_to_table_width():
+    p = SparseContext(block_size=4, table_width=8,
+                      num_sliding_window_blocks=8, num_global_blocks=3)
+    assert p.K == 8
+    for qb in range(8):
+        assert [b for b in p.lut[qb] if b >= 0] == list(range(qb + 1))
+
+
+def test_layout_is_causal_bslongformer_shape():
+    lay = _policy_layout(3, 2, 8)
+    assert lay.shape == (8, 8)
+    assert np.all(np.triu(lay, 1) == 0)          # causal: never forward
+    assert np.all(lay[:, :2] == np.tril(np.ones((8, 2)))[:, :2])  # anchors
+    assert lay[7].tolist() == [1, 1, 0, 0, 0, 1, 1, 1]
+    p = SparseContext(block_size=4, table_width=8,
+                      num_sliding_window_blocks=3, num_global_blocks=2)
+    np.testing.assert_array_equal(p.layout(8), lay)
+
+
+def test_active_row_matches_layout_to_token_mask_reference():
+    """Decode-side reference parity: the token positions a lane's active
+    row exposes (sentinel pads dropped, causally clipped) equal the XLA
+    ``layout_to_token_mask`` expansion of ``layout()`` at EVERY query
+    position — the policy compiler and the ops/sparse_attention mask
+    path agree token-for-token."""
+    bs, W = 4, 16
+    p = SparseContext(block_size=bs, table_width=W,
+                      num_sliding_window_blocks=3, num_global_blocks=2)
+    mask = np.asarray(layout_to_token_mask(p.layout(W)[None], bs))[0]
+    table_row = np.arange(1, W + 1, dtype=np.int32)   # every block live
+    for pos in range(W * bs):
+        stables, sbase = p.active_row(table_row, pos)
+        vis = {int(b) + o
+               for b, k in zip(sbase, stables) if b != p.sentinel
+               for o in range(bs) if int(b) + o <= pos}
+        ref = {j for j in range(pos + 1) if mask[pos, j]}
+        assert vis == ref, f"pos={pos}"
+
+
+def test_prefill_union_row_matches_token_mask_reference():
+    """Prefill-side reference parity: the chunk's union gather row
+    restricted by the in-jit per-query layout mask equals the token
+    mask reference for every query in the chunk."""
+    bs, W, C = 4, 16, 8
+    p = SparseContext(block_size=bs, table_width=W,
+                      num_sliding_window_blocks=3, num_global_blocks=1)
+    mask = np.asarray(layout_to_token_mask(p.layout(W)[None], bs))[0]
+    lay = p.layout(W) > 0
+    table_row = np.arange(1, W + 1, dtype=np.int32)
+    for start in range(0, W * bs - C, C):
+        stables, sbase = p.prefill_active_row(table_row, start, C, C)
+        assert len(stables) == p.prefill_K(C)
+        for q in range(C):
+            pos = start + q
+            qb = min(pos // bs, W - 1)
+            vis = set()
+            for b in sbase:
+                if b == p.sentinel:
+                    continue
+                for o in range(bs):
+                    j = int(b) + o
+                    # the in-jit allow mask: layout[qb, key block]
+                    if j <= pos and lay[qb, min(j // bs, W - 1)]:
+                        vis.add(j)
+            ref = {j for j in range(pos + 1) if mask[pos, j]}
+            assert vis == ref, f"start={start} q={q}"
+
+
+def test_active_row_maps_holes_and_pads_to_trash_sentinel():
+    p = SparseContext(block_size=4, table_width=8,
+                      num_sliding_window_blocks=2, num_global_blocks=1)
+    # logical blocks 1..2 window-expired (trash in the table row)
+    table_row = np.asarray([7, TRASH_BLOCK, TRASH_BLOCK, 5, 9, 0, 0, 0],
+                           np.int32)
+    stables, sbase = p.active_row(table_row, 17)   # query block 4
+    # active set {0, 3, 4} -> phys {7, 5, 9}; no trash page is ever live
+    assert stables.tolist() == [7, 5, 9]
+    assert sbase.tolist() == [0, 12, 16]
+    stables, sbase = p.active_row(table_row, 9)    # qb 2: holes in-window
+    assert stables.tolist() == [7, TRASH_BLOCK, TRASH_BLOCK]
+    assert sbase.tolist() == [0, int(p.sentinel), int(p.sentinel)]
+    live = sbase != p.sentinel
+    assert np.all(stables[~live] == TRASH_BLOCK)
+    assert np.all(stables[live] != TRASH_BLOCK)
+
+
+def test_first_active_block_and_prefill_K():
+    p = SparseContext(block_size=4, table_width=16,
+                      num_sliding_window_blocks=3, num_global_blocks=1)
+    assert p.first_active_block(0) == 0
+    assert p.first_active_block(11) == 0
+    assert p.first_active_block(12) == 1
+    assert p.first_active_block(63) == 13
+    # chunk of 8 tokens spans <= 3 blocks: g + win + span
+    assert p.prefill_K(8) == min(16, 1 + 3 + 3)
+    assert p.prefill_K(64) == 16                    # clamps at W
+
+
+def test_from_sparsity_config_object():
+    class SC:                      # BSLongformer-style duck type
+        num_sliding_window_blocks = 4
+        global_block_indices = [0]
+        global_block_end_indices = [2]
+
+    p = SparseContext.from_sparsity_config(SC(), block_size=4,
+                                           table_width=16)
+    assert p.win == 3 and p.g == 2                 # w//2+1 causal clip
+
+    class Bad:
+        num_sliding_window_blocks = 4
+        global_block_indices = [0, 5]              # not a leading prefix
+
+    with pytest.raises(ValueError, match="leading prefix"):
+        SparseContext.from_sparsity_config(Bad(), block_size=4,
+                                           table_width=16)
+
+
+# ---------------------------------------------------------------------------
+# engine parity (acceptance)
+# ---------------------------------------------------------------------------
+
+def test_full_window_sparse_is_bit_identical_to_dense(toy):
+    """The acceptance escape hatch: globals + window >= W makes every
+    gather row the dense table — greedy tokens match the dense engine
+    AND single-sequence generate() exactly."""
+    model, params, ref = toy
+    prompts = _prompts(3, (5, 11, 3, 9))
+    maxnew = [6, 9, 12, 5]
+    dense = _engine(model, params)
+    sparse = _engine(model, params,
+                     sparse_context={"num_sliding_window_blocks": 16,
+                                     "num_global_blocks": 0})
+    assert sparse.sparse is not None and sparse.sparse.K == 16
+    outs = {}
+    for eng in (dense, sparse):
+        rids = [eng.submit(p, max_new_tokens=m)
+                for p, m in zip(prompts, maxnew)]
+        res = eng.serve(max_steps=500)
+        outs[eng] = [res[r]["tokens"] for r in rids]
+    for d, s, p, m in zip(outs[dense], outs[sparse], prompts, maxnew):
+        np.testing.assert_array_equal(d, s)
+        np.testing.assert_array_equal(s, ref(p, m))
+    rep = sparse.serving_report()
+    assert rep["config"]["sparse_context"]["active_pages_per_lane"] == 16
+    assert rep["sparse_context"]["active_page_fraction"] == 1.0
+
+
+def test_narrow_window_is_chunk_invariant_and_actually_sparse(toy):
+    """Under a genuinely narrow window the greedy continuation must be
+    IDENTICAL whichever prefill chunking produced the KV (per-query
+    masking makes chunk boundaries invisible), and must DIFFER from the
+    dense continuation once the prompt outgrows the window (the mask
+    actually bites)."""
+    model, params, ref = toy
+    prompt = _prompts(5, (37,))[0]
+    sc = {"num_sliding_window_blocks": 3, "num_global_blocks": 1}
+    outs = []
+    for chunk in (8, 16, 64):
+        eng = _engine(model, params, prefill_chunk=chunk,
+                      sparse_context=dict(sc))
+        rid = eng.submit(prompt, max_new_tokens=8)
+        eng.serve(max_steps=500)
+        outs.append(eng.result(rid).tolist())
+    assert outs[0] == outs[1] == outs[2]
+    assert outs[0] != ref(prompt, 8).tolist()     # sparsity engaged
+    frac = eng.serving_report()["sparse_context"]["active_page_fraction"]
+    assert frac is not None and frac < 1.0
+
+
+def test_sparse_zero_recompiles_after_warmup(toy):
+    """The zero-recompile pin holds with sparse armed: fixed K keeps
+    the sparse decode + bucketed prefill jits at one compile each, so
+    admission/finish churn compiles nothing after warmup."""
+    model, params, ref = toy
+    eng = _engine(model, params,
+                  sparse_context={"num_sliding_window_blocks": 2,
+                                  "num_global_blocks": 1})
+    eng.warmup()
+    prompts = _prompts(7, (5, 11, 3, 9, 6))
+    maxnew = [6, 9, 12, 5, 7]
+    with CompilationCounter() as cc:
+        rids = []
+        for p, m in zip(prompts, maxnew):
+            rids.append(eng.submit(p, max_new_tokens=m))
+            eng.step()
+            eng.step()
+        eng.serve(max_steps=500)
+    assert eng.metrics.decode_steps >= 20
+    assert cc.count == 0, \
+        f"{cc.count} XLA compilations during sparse steady-state churn"
+    names = set(eng.program_registry.names())
+    assert "sparse_decode_step" in names
+    assert any(n.startswith("sparse_prefill_chunk") for n in names)
+
+
+def test_window_expired_frees_shrink_resident_blocks(toy):
+    """As decode slides past the window, expired private pages go back
+    to the allocator mid-flight: the pool's free count recovers while
+    the request is still RUNNING, and the freed total is reported."""
+    model, params, _ = toy
+    eng = _engine(model, params, max_slots=1,
+                  sparse_context={"num_sliding_window_blocks": 2,
+                                  "num_global_blocks": 1})
+    prompt = _prompts(9, (30,))[0]
+    rid = eng.submit(prompt, max_new_tokens=16)
+    free_during = []
+    steps = 0
+    while eng.scheduler.has_work() and steps < 400:
+        eng.step()
+        steps += 1
+        if rid not in eng.results:
+            free_during.append(eng.pool.free_blocks(0))
+    assert rid in eng.results and eng.results[rid]["tokens"].size == 46
+    assert eng.pool.window_frees > 0
+    assert eng.pool.stats()["window_expired_frees"] == eng.pool.window_frees
+    # blocks came BACK while running (window slid past them), not only
+    # at finish — the long-context residency win
+    assert max(free_during) > min(free_during)
+    assert eng.serving_report()["sparse_context"][
+        "window_expired_frees"] > 0
+
+
+# ---------------------------------------------------------------------------
+# pool: window-expired reclamation x prefix-cache refcounts
+# ---------------------------------------------------------------------------
+
+def test_pool_window_expired_free_keeps_holes_and_anchors():
+    cfg = GPT2Config(vocab_size=97, n_positions=64, n_embd=32, n_layer=1,
+                     n_head=2, dtype=jnp.float32, loss_chunk_tokens=0)
+    pool = PagedKVPool(cfg, num_blocks=16, block_size=4)
+    assert pool.alloc(1, 0, 24)                      # 6 blocks
+    before = list(pool._blocks[1])
+    # window start at logical block 4, one global anchor kept
+    n = pool.window_expired_free(1, 4, keep_blocks=1)
+    assert n == 3                                    # blocks 1, 2, 3
+    blocks = pool._blocks[1]
+    assert blocks[0] == before[0] and blocks[1:4] == [None] * 3
+    assert blocks[4:] == before[4:]
+    # positional indexing preserved: holes map to trash in the table row
+    row = pool.table_row(1, 8)
+    assert row[0] == before[0] and list(row[1:4]) == [TRASH_BLOCK] * 3
+    assert pool.blocks_of(1) == 3
+    # idempotent: a second sweep over the same range frees nothing
+    assert pool.window_expired_free(1, 4, keep_blocks=1) == 0
+    assert pool.window_frees == 3
+    pool.free(1)                                     # holes don't crash
+    assert pool.free_blocks(0) == 15
+
+
+def test_window_free_skips_prefix_shared_blocks_engine_level(toy):
+    """Satellite: COW-attach a cached prefix that lies partly OUTSIDE
+    the sparse window.  The radix tree's ownership outranks the window:
+    tree-held shared pages are never window-freed, refcounts stay
+    consistent, and the pool balances to empty after both finish."""
+    model, params, _ = toy
+    eng = _engine(model, params, max_slots=1, prefix_cache=True,
+                  sparse_context={"num_sliding_window_blocks": 2,
+                                  "num_global_blocks": 1})
+    free0 = sum(eng.pool.free_blocks(s) for s in range(eng.pool.shards))
+    shared = _prompts(11, (16,))[0]                  # 4 full blocks
+    r1 = eng.submit(shared, max_new_tokens=4)
+    eng.serve(max_steps=300)
+    # the finished prefix is now tree-held; its blocks sit outside a
+    # win=2 window almost immediately for the second request
+    assert len(eng.pool.prefix_lookup(0, shared)[0]) > 0
+    r2 = eng.submit(np.concatenate([shared, _prompts(12, (8,))[0]])
+                    .astype(np.int32), max_new_tokens=6)
+    eng.serve(max_steps=300)
+    assert eng.results[r1]["tokens"].size == 20
+    assert eng.results[r2]["tokens"].size == 30
+    # the cached prefix SURVIVED the second request's window sweeps
+    assert len(eng.pool.prefix_lookup(0, shared)[0]) > 0
+    # no double-free: every non-tree block is back; the allocator's
+    # books balance (tree-held blocks are the only residents)
+    free_now = sum(eng.pool.free_blocks(s) for s in range(eng.pool.shards))
+    held = free0 - free_now
+    assert 0 < held <= 6                             # prefix + extension
+    assert eng.pool.fragmentation() >= 0.0
+
+
+def test_full_window_sparse_with_prefix_cache_matches_dense(toy):
+    """Prefix sharing + sparse gather compose bit-identically at full
+    window: COW-attached pages are gathered via the same stables row."""
+    model, params, ref = toy
+    shared = _prompts(13, (9,))[0]
+    p2 = np.concatenate([shared, _prompts(14, (4,))[0]]).astype(np.int32)
+    outs = {}
+    for name, kw in (("dense", {}),
+                     ("sparse", {"sparse_context":
+                                 {"num_sliding_window_blocks": 16}})):
+        eng = _engine(model, params, prefix_cache=True, **kw)
+        ra = eng.submit(shared, max_new_tokens=5)
+        eng.serve(max_steps=300)
+        rb = eng.submit(p2, max_new_tokens=5)
+        eng.serve(max_steps=300)
+        outs[name] = (eng.results[ra]["tokens"], eng.results[rb]["tokens"])
+        if name == "sparse":
+            assert eng.metrics.prefix_hits >= 1
+    np.testing.assert_array_equal(outs["dense"][0], outs["sparse"][0])
+    np.testing.assert_array_equal(outs["dense"][1], outs["sparse"][1])
+    np.testing.assert_array_equal(outs["sparse"][0], ref(shared, 5))
+
+
+# ---------------------------------------------------------------------------
+# admission validation (satellite)
+# ---------------------------------------------------------------------------
+
+def test_submit_rejects_oversized_prompt_with_actionable_error(toy):
+    model, params, _ = toy
+    eng = _engine(model, params)                     # capacity 16*4 = 64
+    with pytest.raises(AssertionError) as e:
+        eng.submit(_prompts(15, (60,))[0], max_new_tokens=10)
+    msg = str(e.value)
+    assert "70" in msg and "64" in msg               # the numbers, named
+    assert "capacity" in msg and "blocks" in msg     # and the knobs
+
+
+def test_submit_rejects_nonpositive_deadline(toy):
+    model, params, _ = toy
+    eng = _engine(model, params)
+    with pytest.raises(ValueError, match="deadline_s=0"):
+        eng.submit(_prompts(15, (5,))[0], max_new_tokens=4, deadline_s=0)
+    with pytest.raises(ValueError, match="positive"):
+        eng.submit(_prompts(15, (5,))[0], max_new_tokens=4,
+                   deadline_s=-1.5)
+
+
+def test_submit_rejects_deadline_impossible_max_new(toy, caplog):
+    """A deadline even PERFECT service cannot meet is rejected at
+    admission — status expired, prompt echoed, zero prefill work — and
+    the warning names the lower bound and both remedies."""
+    model, params, _ = toy
+    eng = _engine(model, params)
+    r0 = eng.submit(_prompts(16, (5,))[0], max_new_tokens=4)
+    eng.serve(max_steps=200)                         # establish step EMA
+    assert eng.metrics.step_time() is not None
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            rid = eng.submit(_prompts(16, (8,))[0], max_new_tokens=40,
+                             deadline_s=1e-9)
+    finally:
+        ds_logger.propagate = False
+    assert eng.results[rid]["status"] == ABORT_EXPIRED
+    assert eng.results[rid]["tokens"].size == 8      # prompt only
+    assert any("deadline-impossible" in r.message for r in caplog.records)
+    # feasible-in-isolation is NEVER predictively rejected
+    r2 = eng.submit(_prompts(16, (5,))[0], max_new_tokens=4,
+                    deadline_s=3600.0)
+    eng.serve(max_steps=200)
+    assert eng.results[r2]["tokens"].size == 9
+    del r0
+
+
+# ---------------------------------------------------------------------------
+# chunked-prefill fairness (scheduler + engine)
+# ---------------------------------------------------------------------------
+
+def test_prefill_fairness_pauses_long_prompt_for_short(toy):
+    """With a 1-chunk quantum, a giant prompt yields its lane after
+    every chunk: the short request's first token lands BEFORE the giant
+    finishes prefill, and both streams still match generate() exactly
+    (pausing keeps the slot's pool pages and prefill progress)."""
+    model, params, ref = toy
+    long_p, short_p = _prompts(17, (33, 3))
+    done_order = {}
+
+    def run(fairness):
+        eng = _engine(model, params, max_slots=2, prefill_chunk=8,
+                      prefill_fairness=fairness)
+        rl = eng.submit(long_p, max_new_tokens=4)
+        rs = eng.submit(short_p, max_new_tokens=4)
+        steps = 0
+        order = []
+        while eng.scheduler.has_work() and steps < 400:
+            eng.step()
+            steps += 1
+            for r in (rl, rs):
+                if r in eng.results and r not in order:
+                    order.append(r)
+        np.testing.assert_array_equal(eng.results[rl]["tokens"],
+                                      ref(long_p, 4))
+        np.testing.assert_array_equal(eng.results[rs]["tokens"],
+                                      ref(short_p, 4))
+        done_order[fairness] = [("long" if r == rl else "short")
+                                for r in order]
+        return eng
+
+    run(0)
+    assert done_order[0] == ["long", "short"]        # FCFS starves short
+    eng = run(1)
+    assert done_order[1] == ["short", "long"]        # fairness preempts
+    assert eng.serving_report()["config"]["prefill_fairness"] == 1
+
+
+def test_prefill_fairness_quantum_bounds_pauses(toy):
+    """A larger quantum pauses less: with quantum >= total chunks the
+    giant never yields (degenerates to FCFS), so fairness is a dial."""
+    model, params, ref = toy
+    long_p, short_p = _prompts(18, (33, 3))
+    eng = _engine(model, params, max_slots=2, prefill_chunk=8,
+                  prefill_fairness=10)
+    rl = eng.submit(long_p, max_new_tokens=4)
+    rs = eng.submit(short_p, max_new_tokens=4)
+    eng.serve(max_steps=400)
+    np.testing.assert_array_equal(eng.results[rl]["tokens"],
+                                  ref(long_p, 4))
+    np.testing.assert_array_equal(eng.results[rs]["tokens"],
+                                  ref(short_p, 4))
+    assert not eng.scheduler.paused
+
+
+# ---------------------------------------------------------------------------
+# DISARMED discipline
+# ---------------------------------------------------------------------------
+
+def _warns_disarmed(caplog, fn):
+    ds_logger.propagate = True
+    try:
+        with caplog.at_level(logging.WARNING):
+            eng = fn()
+    finally:
+        ds_logger.propagate = False
+    assert any("sparse context: DISARMED" in r.message
+               for r in caplog.records)
+    assert eng.sparse is None and eng._decode_name == "decode_step"
+    return eng
+
+
+def test_sparse_disarms_on_misaligned_window_tokens(toy, caplog):
+    model, params, _ = toy
+    eng = _warns_disarmed(caplog, lambda: _engine(
+        model, params, sparse_context={"window_tokens": 10}))
+    # the warning suggests both block-aligned roundings
+    assert any("8 or 12" in r.message.replace("\n", " ")
+               for r in caplog.records) or \
+        any("Round the window" in r.message for r in caplog.records)
+    del eng
+
+
+def test_sparse_disarms_on_beam_width(toy, caplog):
+    model, params, _ = toy
+    _warns_disarmed(caplog, lambda: _engine(
+        model, params,
+        sparse_context={"num_sliding_window_blocks": 2, "beam_width": 4}))
+
+
+def test_sparse_disarms_under_speculation(toy, caplog):
+    model, params, _ = toy
+    eng = _warns_disarmed(caplog, lambda: _engine(
+        model, params, speculative=3,
+        sparse_context={"num_sliding_window_blocks": 2}))
+    assert eng.spec_k == 3                           # speculation wins
+
+
+def test_sparse_disarms_on_mismatched_prebuilt_context(toy, caplog):
+    model, params, _ = toy
+    wrong = SparseContext(block_size=8, table_width=4,
+                          num_sliding_window_blocks=2)
+    _warns_disarmed(caplog, lambda: _engine(
+        model, params, sparse_context=wrong))
+
+
+def test_sparse_disarms_on_nonprefix_globals(toy, caplog):
+    class SC:
+        num_sliding_window_blocks = 4
+        global_block_indices = [0, 7]
+
+    model, params, _ = toy
+    _warns_disarmed(caplog, lambda: _engine(
+        model, params, sparse_context=SC()))
+
+
+def test_window_tokens_arms_when_block_aligned(toy):
+    model, params, _ = toy
+    eng = _engine(model, params, sparse_context={"window_tokens": 12})
+    assert eng.sparse is not None and eng.sparse.win == 3
+    assert eng._decode_name == "sparse_decode_step"
+
+
+# ---------------------------------------------------------------------------
+# accounting + metrics
+# ---------------------------------------------------------------------------
+
+def test_sparse_kv_blocks_per_seq():
+    # short sequences: bounded by their own length
+    assert ma.sparse_kv_blocks_per_seq(
+        1000, 512, num_sliding_window_blocks=8, num_global_blocks=2) == 2
+    # long sequences: bounded by the policy
+    assert ma.sparse_kv_blocks_per_seq(
+        32768, 512, num_sliding_window_blocks=8, num_global_blocks=2) == 10
+    dense = -(-32768 // 512)
+    assert dense == 64                               # the 6.4x story
+
+
+def test_serving_gather_and_flops_scale_with_active_pages():
+    kw = dict(batch=2, kv_dtype="bfloat16")
+    dense = ca.serving_gather_bytes_per_step(24, 16, 512, 64, pages=64,
+                                             **kw)
+    sparse = ca.serving_gather_bytes_per_step(24, 16, 512, 64, pages=10,
+                                              **kw)
+    assert dense == sparse * 64 // 10 or dense / sparse == 6.4
+    q = ca.serving_gather_bytes_per_step(24, 16, 512, 64, pages=10,
+                                         batch=2, quantized=True)
+    assert q < sparse                                # int8 + scales < bf16
+    f_dense = ca.serving_decode_attn_flops(24, 16, 64, attended=32768)
+    f_sparse = ca.serving_decode_attn_flops(24, 16, 64, attended=5120)
+    assert f_dense / f_sparse == 6.4
+
+
+def test_metrics_active_page_fraction_honest_gap():
+    from deepspeed_tpu.serving.metrics import ServingMetrics
+    m = ServingMetrics()
+    assert m.active_page_fraction() is None          # no gathers yet
+    m.record_gather(2, 20, 128, 18)
+    m.record_gather(2, 20, 128, 16)
+    assert m.active_page_fraction() == 40 / 256
+    m.record_window_expired(3)
+    rep = m.report()["sparse_context"]
+    assert rep["window_expired_frees"] == 3
+    assert rep["gathered_pages_per_lane_step"] == 10.0
+    assert rep["active_pages_per_lane_step"] == 8.5
+    m.record_submit(1, klass="short")
+    m.record_submit(2, klass="long")
+    assert m.class_ttft_p95("short") is None         # no tokens yet

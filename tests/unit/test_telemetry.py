@@ -578,9 +578,11 @@ def test_serving_abort_events_traced(serving_toy):
     from deepspeed_tpu.serving.reliability import ReliabilityConfig
 
     eng = _serve_engine(model, params, trace=True)
-    eng.reliability.config = ReliabilityConfig(default_deadline_s=0.0)
+    # deadline_s=0 is now rejected at admission (not a budget at all);
+    # a vanishingly small positive one expires at the first sweep
+    eng.reliability.config = ReliabilityConfig(default_deadline_s=1e-9)
     eng.warmup()
-    eng.submit(np.zeros(4, np.int32), 4, deadline_s=0.0)
+    eng.submit(np.zeros(4, np.int32), 4, deadline_s=1e-9)
     eng.step()
     names = [e["name"] for e in eng.telemetry.tracer.events()]
     assert "abort_expired" in names

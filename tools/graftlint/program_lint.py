@@ -522,10 +522,11 @@ def _corpus_pipe_bf16():
 
 
 def _corpus_serving():
-    """Continuous-batching serving, two engines: a plain one (the
-    decode_step jit — speculative replaces it wholesale) and one with
+    """Continuous-batching serving, three engines: a plain one (the
+    decode_step jit — speculative replaces it wholesale), one with
     prefix cache + speculative decoding (prefill buckets, COW page
-    copy, spec verify)."""
+    copy, spec verify), and one with a sparse attention context (the
+    sparse decode/prefill jit variants gather K active pages)."""
     import numpy as np
 
     import jax
@@ -561,7 +562,19 @@ def _corpus_serving():
                 .astype(np.int32), max_new_tokens=6)
     spec.serve(max_steps=200)
     spec.program_registry.engine = "serving-spec"
-    return [plain.program_registry, spec.program_registry]
+
+    sparse = InferenceEngine(model, params, max_slots=2, kv_block_size=4,
+                             prefill_chunk=8, max_blocks_per_seq=8,
+                             sparse_context={"num_sliding_window_blocks": 2,
+                                             "num_global_blocks": 1})
+    # 9-token prompt: one full chunk8 + a 1-token final chunk (bucket 4)
+    # covers both sparse prefill shapes plus the sparse decode step
+    sparse.submit(rng.integers(0, 97, 9).astype(np.int32),
+                  max_new_tokens=4)
+    sparse.serve(max_steps=200)
+    sparse.program_registry.engine = "serving-sparse"
+    return [plain.program_registry, spec.program_registry,
+            sparse.program_registry]
 
 
 CORPUS_BUILDERS = {

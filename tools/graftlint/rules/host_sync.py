@@ -53,6 +53,11 @@ HOT_FILES = {
     # a host sync in any of their loops stalls the optimizer wire
     "deepspeed_tpu/runtime/quantization.py",
     "deepspeed_tpu/runtime/custom_collectives.py",
+    # sparse page attention (ISSUE 20): the per-lane LUT walk
+    # (active_row / prefill_active_row) runs once per decode dispatch
+    # over every running lane, and window-expired reclamation runs at
+    # the same cadence — all pure numpy on host tables by contract
+    "deepspeed_tpu/serving/sparse_context.py",
 }
 HOT_FN_RE = re.compile(
     r"^(train_batch|eval_batch|forward|backward|step"
@@ -113,7 +118,11 @@ HOT_FN_RE = re.compile(
     # protect), and the sign pack/quantize kernels + collective
     # round-trip helpers execute inside every sync round's program
     r"|_zeroone_\w+|quantize_\w+|dequantize_\w+|pack_signs\w*"
-    r"|unpack_signs\w*|sign_pack_layout|compressed_allreduce)$")
+    r"|unpack_signs\w*|sign_pack_layout|compressed_allreduce"
+    # sparse page attention (ISSUE 20): the LUT→active-page walk and
+    # window-expired free run per lane per decode step; a device sync
+    # there serializes every running sequence against the host
+    r"|active_row|prefill_active_row|window_expired_free)$")
 # benchmark drivers: every loop is (or brackets) a timed region — a sync
 # per iteration pollutes the measured step time with transfer latency
 BENCH_FILES = {"bench.py", "tools/pipe_bench.py", "tools/serve_bench.py"}
@@ -147,7 +156,12 @@ COLD_BUILDER_NAMES = {"build_gather_plan", "_arm_stage3",
                       # per step would rebuild the wire decision (and
                       # its WARNING spam) on every train_batch
                       "_arm_zeroone", "_arm_quantized_allreduce",
-                      "_compile_zeroone"}
+                      "_compile_zeroone",
+                      # sparse-context arming (ISSUE 20): blocker scan
+                      # + LUT compile happen once at engine build — a
+                      # per-step re-arm would rebuild the (W, K) LUTs
+                      # and re-emit the DISARMED warning every decode
+                      "_arm_sparse_context", "_compile_luts"}
 
 SYNC_METHOD_ATTRS = {"item", "block_until_ready"}
 SYNC_FN_NAMES = {"device_get", "block_until_ready"}

@@ -44,6 +44,19 @@ GROWTH_TOLERANCE = 0.10
 _POOL = dict(n_layer=24, num_blocks=513, n_head=16, block_size=16,
              head_dim=64)
 
+# long-context serving pool (ISSUE 20): 4 slots x 64 blocks/seq of
+# 512-token blocks = 32k tokens per lane, + 1 trash block.  The dense
+# pool holds every block of every lane; the sparse-window variant holds
+# only what the sliding-window + global-anchor policy keeps RESIDENT
+# per lane (window-expired blocks are reclaimed as the window slides),
+# sized by memory_accounting.sparse_kv_blocks_per_seq.
+_POOL_32K = dict(n_layer=24, num_blocks=4 * 64 + 1, n_head=16,
+                 block_size=512, head_dim=64)
+_POOL_32K_SPARSE = dict(
+    _POOL_32K,
+    num_blocks=4 * ma.sparse_kv_blocks_per_seq(
+        32768, 512, num_sliding_window_blocks=8, num_global_blocks=2) + 1)
+
 # zb-h1 stash-peak config: the schedule's peak live stash micros per
 # stage (bubble_accounting.simulate over the stash-compiled stream) x a
 # fixed per-micro residual scale of seq x hidden bf16 boundary
@@ -90,6 +103,16 @@ CONFIGS = {
     "serving/gpt2-350m-ish/decode-b8/pool-int8-prefix-shared": dict(
         pool=dict(_POOL, kv_dtype="bfloat16", quantized=True,
                   shared_blocks=16, shared_refs=8)),
+    # long-context 32k pools (ISSUE 20): the dense pool in bf16 and
+    # int8, and the sliding-window resident footprint (win=8 g=2 ->
+    # 10 of 64 blocks/seq resident) that window-expired reclamation
+    # sustains — the budget gates the pool a 32k deployment must size
+    "serving/gpt2-350m-ish/long-context-32k/pool-bf16": dict(
+        pool=dict(_POOL_32K, kv_dtype="bfloat16", quantized=False)),
+    "serving/gpt2-350m-ish/long-context-32k/pool-int8": dict(
+        pool=dict(_POOL_32K, kv_dtype="bfloat16", quantized=True)),
+    "serving/gpt2-350m-ish/long-context-32k/pool-bf16-sparse-win8g2": dict(
+        pool=dict(_POOL_32K_SPARSE, kv_dtype="bfloat16", quantized=False)),
     # zb-h1 bounded stashing: worst-stage peak stash bytes (see _STASH)
     "gpt2-350m-ish/pipe4/gas8/zb-stash-peak": dict(stash=_STASH),
 }

@@ -140,6 +140,13 @@ def trend_rows(rounds):
                     payload.get("optimizer_wire_bytes_per_step"),
                 "optimizer_wire_vs_qgz":
                     payload.get("optimizer_wire_vs_qgz"),
+                # long-context serving (ISSUE 20): rounds without a
+                # sparse-attention leg lack the keys and show as honest
+                # gaps — a None fraction must never read as "gathered
+                # nothing", nor a None p95 as instant first tokens
+                "active_page_fraction":
+                    payload.get("active_page_fraction"),
+                "short_ttft_p95": payload.get("short_ttft_p95"),
                 "trace": tel.get("trace"),
                 "metrics_jsonl": tel.get("metrics_jsonl"),
             })
@@ -191,7 +198,8 @@ def trend_payload(pattern=DEFAULT_GLOB, root=".",
                      "corruption_recovered", "peak_hbm_bytes",
                      "hbm_delta_vs_analytic", "prefix_hit_rate",
                      "tokens_per_verify", "optimizer_wire_bytes_per_step",
-                     "optimizer_wire_vs_qgz")} for r in rows],
+                     "optimizer_wire_vs_qgz", "active_page_fraction",
+                     "short_ttft_p95")} for r in rows],
         "dead_rounds": [r["round"] for r in rows if not r["ok"]],
         "regression": check_regression(rows, threshold),
     }
@@ -227,7 +235,7 @@ def main(argv=None):
         print(f"{'round':>5} {'ok':>3} {'value':>10} {'mfu':>7} "
               f"{'step_ms':>9} {'tok/s':>12} {'det.lat':>8} {'recov':>6} "
               f"{'hbm_GiB':>8} {'pfx_hit':>8} {'tok/ver':>8} "
-              f"{'wire_MB':>8}  metric")
+              f"{'wire_MB':>8} {'pg_frac':>8} {'s_ttft95':>8}  metric")
         for r in rows:
             hbm = r.get("peak_hbm_bytes")
             wire = r.get("optimizer_wire_bytes_per_step")
@@ -240,7 +248,9 @@ def main(argv=None):
                   f"{_fmt(hbm / 2**30 if hbm else None, 2):>8} "
                   f"{_fmt(r.get('prefix_hit_rate'), 3):>8} "
                   f"{_fmt(r.get('tokens_per_verify'), 3):>8} "
-                  f"{_fmt(wire / 2**20 if wire else None, 2):>8}  "
+                  f"{_fmt(wire / 2**20 if wire else None, 2):>8} "
+                  f"{_fmt(r.get('active_page_fraction'), 3):>8} "
+                  f"{_fmt(r.get('short_ttft_p95'), 1):>8}  "
                   f"{(r.get('metric') or '-')[:60]}")
         if verdict["baseline"]:
             word = "REGRESSED" if verdict["regressed"] else "ok"

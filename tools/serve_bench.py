@@ -33,6 +33,15 @@ Traffic modes (``--traffic``):
   the guard is that EVERY request still completes (zero lost) and the
   reported p95-TTFT / goodput ratios are the measured price of losing
   1/K of the fleet.
+- ``long-context`` — sparse page attention A/B (ISSUE 20): book-length
+  prompts (``--lc-len`` tokens in ``--lc-block``-token pool blocks)
+  plus chatty shorts, served dense vs under a sliding-window +
+  global-anchor SparseContext (``--lc-window-blocks``/``--lc-globals``)
+  with window-expired page reclamation and chunked-prefill fairness
+  (``--lc-fairness``).  Guards: >= 4x fewer pages gathered per
+  dispatched lane, ZERO XLA compilations in the sparse timed region,
+  short-request p95 TTFT (step clock) no worse than dense, window
+  frees observed.
 - ``diurnal`` — the autoscaling A/B (ISSUE 16): a quiet->peak->quiet
   arrival profile served twice on the step clock — once by a STATIC
   fleet provisioned for the peak (``--fleet K`` replicas the whole
@@ -144,10 +153,12 @@ def _arrival_schedule(n, *, every=1, burst=1, gap=0):
 def run_mode(model, params, workload, *, policy, slots, chunk,
              arrivals, reliability=None, clock=None, step_clock=False,
              deadline=None, block=16, prefix_cache=False,
-             speculative=None):
+             speculative=None, sparse_context=None, prefill_fairness=0,
+             max_blocks=8, count_compiles=False):
     import jax
 
     from deepspeed_tpu.serving.engine import InferenceEngine
+    from deepspeed_tpu.serving.metrics import CompilationCounter
 
     kw = {}
     if reliability is not None:
@@ -156,10 +167,15 @@ def run_mode(model, params, workload, *, policy, slots, chunk,
         kw["clock"] = clock
     eng = InferenceEngine(model, params, max_slots=slots,
                           kv_block_size=block, prefill_chunk=chunk,
-                          max_blocks_per_seq=8, policy=policy,
+                          max_blocks_per_seq=max_blocks, policy=policy,
                           prefix_cache=prefix_cache,
-                          speculative=speculative, **kw)
+                          speculative=speculative,
+                          sparse_context=sparse_context,
+                          prefill_fairness=prefill_fairness, **kw)
     eng.warmup()                       # compiles outside the timed region
+    cc = CompilationCounter() if count_compiles else None
+    if cc is not None:
+        cc.__enter__()
     t0 = time.perf_counter()
     pending = [(arrivals[i], w) for i, w in enumerate(workload)]
     submitted = 0
@@ -177,8 +193,11 @@ def run_mode(model, params, workload, *, policy, slots, chunk,
     # one drain point for the whole run, NOT per step
     jax.block_until_ready(eng.pool.tensors.k)
     wall = time.perf_counter() - t0
+    if cc is not None:
+        cc.__exit__(None, None, None)
     rep = eng.serving_report()
     rel = rep["reliability"]
+    sp = rep["sparse_context"]
     return {
         "policy": policy,
         "submitted": submitted,
@@ -216,6 +235,19 @@ def run_mode(model, params, workload, *, policy, slots, chunk,
         "tokens_per_verify":
             _r(rep["speculative"]["tokens_per_verify"]),
         "spec_accept_hist": rep["speculative"]["accept_len_hist"],
+        # ISSUE 20 long-context accounting: pages the decode/prefill
+        # jits actually gathered vs the dense-equivalent full table,
+        # what the window reclaimed, and the per-class TTFT split the
+        # fairness guard reads
+        "active_page_fraction": _r(sp["active_page_fraction"]),
+        "gathered_pages_per_lane_step":
+            _r(sp["gathered_pages_per_lane_step"], 2),
+        "window_expired_frees": sp["window_expired_frees"],
+        "short_ttft_p95": _r((sp["ttft_by_class"].get("short") or
+                              {}).get("p95")),
+        "long_ttft_p95": _r((sp["ttft_by_class"].get("long") or
+                             {}).get("p95")),
+        "compilations_in_flight": None if cc is None else cc.count,
     }
 
 
@@ -632,12 +664,142 @@ def run_diurnal(model, params, args, out):
     return 0 if ok else 1
 
 
+def build_long_context_toy(vocab, *, n_positions, n_embd=16, n_layer=1):
+    """A deliberately thin model with a LONG position range: the
+    long-context bench is a KV-gather benchmark, not a FLOPs one — the
+    cost under test is pages touched per dispatched lane."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2Model
+    from deepspeed_tpu.utils.jax_compat import ensure_compat
+
+    ensure_compat()
+    cfg = GPT2Config(vocab_size=vocab, n_positions=n_positions,
+                     n_embd=n_embd, n_layer=n_layer, n_head=2,
+                     dtype=jnp.float32, loss_chunk_tokens=0)
+    model = GPT2Model(cfg)
+    ids = np.random.default_rng(0).integers(0, vocab, (2, 8))
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": ids, "labels": ids})
+    return model, params
+
+
+def make_long_context_workload(vocab, seed, *, n_long, long_len,
+                               long_new, n_short):
+    """The adversarial long-context mix: a few book-length prompts that
+    monopolize prefill + chatty short requests arriving underneath
+    them.  Shorts land while the longs are mid-prefill — the shape that
+    exposes both the O(total pages) decode gather and head-of-line
+    blocking in the prefill lane."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    reqs = [(rng.integers(0, vocab, long_len).astype(np.int32), long_new)
+            for _ in range(n_long)]
+    for _ in range(n_short):
+        reqs.append((rng.integers(0, vocab,
+                                  int(rng.integers(8, 25)))
+                     .astype(np.int32),
+                     int(rng.choice([4, 8]))))
+    return reqs
+
+
+def run_long_context(model, params, args, out):
+    """Sparse page attention A/B (ISSUE 20): the SAME 32k-token traffic
+    served dense (every page of every lane gathered each dispatch) vs
+    under a sliding-window + global-anchor SparseContext with window-
+    expired page reclamation and chunked-prefill fairness.  Latencies
+    on the step clock.  Guards: >= 4x fewer gathered pages per lane-
+    step, ZERO XLA compilations in flight on the sparse leg, short-
+    request p95 TTFT no worse than the dense baseline, and identical
+    completion counts."""
+    bs, win, g = args.lc_block, args.lc_window_blocks, args.lc_globals
+    W = args.lc_len // bs + 1                    # headroom for max_new
+    workload = make_long_context_workload(
+        args.vocab, args.seed, n_long=args.lc_long, long_len=args.lc_len,
+        long_new=8, n_short=args.lc_short)
+    # longs first (steps 0, 1), shorts trickling in underneath while
+    # the longs are still chunking through prefill
+    arrivals = list(range(args.lc_long)) + \
+        [2 + 2 * i for i in range(args.lc_short)]
+    out["workload"] = {
+        "long": {"n": args.lc_long, "prompt_tokens": args.lc_len},
+        "short": {"n": args.lc_short},
+        "block_size": bs, "table_width": W,
+        "sparse": {"num_sliding_window_blocks": win,
+                   "num_global_blocks": g},
+        "prefill_fairness": args.lc_fairness,
+    }
+
+    def drive(sparse):
+        clock = StepClock()
+        return run_mode(
+            model, params, workload, policy="continuous",
+            slots=args.lc_slots, chunk=args.lc_chunk, arrivals=arrivals,
+            clock=clock, step_clock=True, block=bs, max_blocks=W,
+            sparse_context=({"num_sliding_window_blocks": win,
+                             "num_global_blocks": g} if sparse else None),
+            prefill_fairness=args.lc_fairness if sparse else 0,
+            count_compiles=sparse)
+
+    dense = drive(False)
+    sparse = drive(True)
+    out.update({"dense": dense, "sparse": sparse,
+                "latency_unit": "serving steps (step clock)"})
+    for tag, row in (("dense", dense), ("sparse", sparse)):
+        print(f"{tag:>18}: {row['tokens']} tok in {row['wall_s']}s | "
+              f"{row['gathered_pages_per_lane_step']} pages/lane-step "
+              f"(fraction {row['active_page_fraction']}) | short p95 "
+              f"TTFT {row['short_ttft_p95']} long {row['long_ttft_p95']}"
+              f" | window frees {row['window_expired_frees']}")
+    ratio = (dense["gathered_pages_per_lane_step"]
+             / sparse["gathered_pages_per_lane_step"]) \
+        if sparse["gathered_pages_per_lane_step"] else None
+    out["gathered_pages_ratio"] = _r(ratio, 3)
+    out["short_ttft_p95_ratio"] = _r(
+        sparse["short_ttft_p95"] / dense["short_ttft_p95"], 3) \
+        if dense["short_ttft_p95"] else None
+
+    ok = True
+    if not (ratio is not None and ratio >= 4.0):
+        print(f"GUARD FAIL: gathered-pages reduction {ratio} < 4x")
+        ok = False
+    if sparse["compilations_in_flight"] != 0:
+        print(f"GUARD FAIL: {sparse['compilations_in_flight']} XLA "
+              f"compilations during the sparse timed region")
+        ok = False
+    if not (sparse["completed"] == sparse["submitted"]
+            == dense["completed"]):
+        print("GUARD FAIL: completion counts diverge")
+        ok = False
+    if dense["short_ttft_p95"] and \
+            sparse["short_ttft_p95"] > dense["short_ttft_p95"]:
+        print(f"GUARD FAIL: sparse short p95 TTFT "
+              f"{sparse['short_ttft_p95']} worse than dense "
+              f"{dense['short_ttft_p95']}")
+        ok = False
+    if not (sparse["window_expired_frees"] > 0):
+        print("GUARD FAIL: the window never reclaimed a page")
+        ok = False
+    out["guard_ok"] = ok
+    print(f"long-context guard: {'OK' if ok else 'FAIL'} — "
+          f"{_fmt_ratio(ratio)} fewer pages gathered per lane-step at "
+          f"{args.lc_len}-token prompts (win={win} g={g} blocks of "
+          f"{bs}), {sparse['window_expired_frees']} window-expired page "
+          f"frees, short p95 TTFT {out['short_ttft_p95_ratio']}x dense, "
+          f"{sparse['compilations_in_flight']} compiles in flight")
+    return 0 if ok else 1
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--traffic", default="steady",
                    choices=["steady", "bursty", "overload",
                             "shared-prefix", "spec-decode",
-                            "replica-failure", "diurnal"])
+                            "replica-failure", "diurnal",
+                            "long-context"])
     p.add_argument("--slots", type=int, default=8)
     p.add_argument("--requests", type=int, default=32)
     p.add_argument("--chunk", type=int, default=16)
@@ -666,10 +828,34 @@ def main(argv=None):
                         "1 (replica-failure)")
     p.add_argument("--draft-len", type=int, default=3,
                    help="speculative draft length k (spec-decode)")
+    p.add_argument("--lc-len", type=int, default=32768,
+                   help="long-prompt tokens (long-context)")
+    p.add_argument("--lc-block", type=int, default=512,
+                   help="KV block size (long-context)")
+    p.add_argument("--lc-chunk", type=int, default=512,
+                   help="prefill chunk (long-context)")
+    p.add_argument("--lc-window-blocks", type=int, default=8,
+                   help="sliding window in blocks (long-context)")
+    p.add_argument("--lc-globals", type=int, default=2,
+                   help="global anchor blocks (long-context)")
+    p.add_argument("--lc-slots", type=int, default=4)
+    p.add_argument("--lc-long", type=int, default=2,
+                   help="book-length prompts (long-context)")
+    p.add_argument("--lc-short", type=int, default=12,
+                   help="chatty short requests (long-context)")
+    p.add_argument("--lc-fairness", type=int, default=4,
+                   help="prefill pause quantum in chunks on the sparse "
+                        "leg (long-context)")
     p.add_argument("--json", default=None)
     args = p.parse_args(argv)
 
-    model, params = build_toy(args.n_embd, args.n_layer, args.vocab)
+    if args.traffic == "long-context":
+        model, params = build_long_context_toy(
+            args.vocab,
+            n_positions=(args.lc_len // args.lc_block + 1)
+            * args.lc_block)
+    else:
+        model, params = build_toy(args.n_embd, args.n_layer, args.vocab)
     out = {"traffic": args.traffic,
            "config": {"slots": args.slots, "requests": args.requests,
                       "chunk": args.chunk, "seed": args.seed}}
@@ -678,7 +864,8 @@ def main(argv=None):
           "shared-prefix": run_shared_prefix,
           "spec-decode": run_spec_decode,
           "replica-failure": run_replica_failure,
-          "diurnal": run_diurnal}[args.traffic](
+          "diurnal": run_diurnal,
+          "long-context": run_long_context}[args.traffic](
         model, params, args, out)
     if args.json:
         with open(args.json, "w") as f:
